@@ -1,0 +1,30 @@
+"""imaginaire_tpu: a TPU-native (JAX/XLA/Pallas) framework for GAN-based
+image and video synthesis, with the capabilities of NVIDIA Imaginaire.
+
+Layer map (mirrors SURVEY.md section 1, re-designed TPU-first):
+
+- ``config``/``registry``  : YAML-over-defaults config; string-keyed component registry.
+- ``parallel``             : device mesh, sharding rules, collectives (replaces
+                             torch.distributed / DDP; ref: imaginaire/utils/distributed.py).
+- ``ops``                  : Pallas kernels + jnp reference implementations for the
+                             reference's CUDA extensions (resample2d, channelnorm,
+                             correlation; ref: imaginaire/third_party/*).
+- ``layers``               : conv/residual block family with the ``order`` micro-DSL,
+                             activation norms (SPADE/AdaIN/...), weight norms
+                             (ref: imaginaire/layers/*).
+- ``models``               : generators + discriminators for the 9 algorithms
+                             (ref: imaginaire/generators, imaginaire/discriminators).
+- ``losses``               : GAN/perceptual/feature-matching/KL/flow losses
+                             (ref: imaginaire/losses/*).
+- ``optim``                : optax-based optimizer factory incl. Fromage/Madam and
+                             lr schedules (ref: imaginaire/optimizers, utils/trainer.py).
+- ``data``                 : config-driven multi-type datasets, folder/shard backends,
+                             augmentation (ref: imaginaire/datasets, utils/data.py).
+- ``trainers``             : functional GAN training harness; jit-compiled sharded
+                             train steps (ref: imaginaire/trainers/*).
+- ``evaluation``           : FID/KID/PRDC (ref: imaginaire/evaluation/*).
+
+All array layouts are NHWC (TPU-native), not the reference's NCHW.
+"""
+
+__version__ = "0.1.0"

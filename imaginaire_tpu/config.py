@@ -1,0 +1,232 @@
+"""Config system: YAML overlaid on a defaults tree, attribute access.
+
+Reproduces the semantics of the reference config system
+(ref: imaginaire/config.py:16-213): an attribute-accessible nested dict,
+a defaults tree pre-seeded before the user YAML is overlaid recursively,
+a YAML float resolver so ``1e-4`` parses as a float (YAML 1.1 quirk), and
+a ``common:`` section broadcast into both ``gen`` and ``dis`` sub-configs.
+
+Design difference from the reference: components are selected by registry
+key (see registry.py) with dotted-module fallback, and the defaults tree
+reflects the TPU runtime (mesh axes, bf16 policy, orbax checkpointing)
+rather than cudnn/apex knobs.
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+
+import yaml
+
+
+class AttrDict(dict):
+    """Dict with attribute access, recursive construction and yaml round-trip."""
+
+    def __init__(self, mapping=None, **kwargs):
+        super().__init__()
+        mapping = dict(mapping or {}, **kwargs)
+        for key, value in mapping.items():
+            self[key] = _wrap(value)
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, _wrap(value))
+
+    def __setattr__(self, key, value):
+        self[key] = value
+
+    def __getattr__(self, key):
+        try:
+            return self[key]
+        except KeyError as exc:
+            raise AttributeError(key) from exc
+
+    def __deepcopy__(self, memo):
+        return AttrDict({k: copy.deepcopy(v, memo) for k, v in self.items()})
+
+    def to_dict(self):
+        out = {}
+        for key, value in self.items():
+            if isinstance(value, AttrDict):
+                out[key] = value.to_dict()
+            elif isinstance(value, list):
+                out[key] = [v.to_dict() if isinstance(v, AttrDict) else v for v in value]
+            else:
+                out[key] = value
+        return out
+
+    def yaml(self):
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    def __repr__(self):
+        return self.yaml()
+
+
+def _wrap(value):
+    if isinstance(value, AttrDict):
+        return value
+    if isinstance(value, dict):
+        return AttrDict(value)
+    if isinstance(value, (list, tuple)):
+        return [_wrap(v) for v in value]
+    return value
+
+
+def recursive_update(base, overlay):
+    """Recursively overlay ``overlay`` onto AttrDict ``base`` in place.
+
+    Matches the reference's overlay rule (ref: imaginaire/config.py:201-213):
+    dicts merge recursively; any other value (including lists) replaces.
+    """
+    for key, value in overlay.items():
+        if isinstance(value, dict) and isinstance(base.get(key), dict):
+            recursive_update(base[key], value)
+        else:
+            base[key] = _wrap(value)
+    return base
+
+
+# YAML 1.1 fails to parse `1e-4` (no dot) as a float; install an implicit
+# resolver that accepts full scientific notation (ref: imaginaire/config.py:154-164).
+class _ConfigLoader(yaml.SafeLoader):
+    pass
+
+
+_ConfigLoader.add_implicit_resolver(
+    "tag:yaml.org,2002:float",
+    re.compile(
+        r"""^(?:
+            [-+]?(?:[0-9][0-9_]*)\.[0-9_]*(?:[eE][-+]?[0-9]+)?
+           |[-+]?(?:[0-9][0-9_]*)(?:[eE][-+]?[0-9]+)
+           |\.[0-9_]+(?:[eE][-+][0-9]+)?
+           |[-+]?\.(?:inf|Inf|INF)
+           |\.(?:nan|NaN|NAN))$""",
+        re.X,
+    ),
+    list("-+0123456789."),
+)
+
+
+def load_yaml(path_or_stream):
+    if hasattr(path_or_stream, "read"):
+        return yaml.load(path_or_stream, Loader=_ConfigLoader)
+    with open(path_or_stream, "r") as f:
+        return yaml.load(f, Loader=_ConfigLoader)
+
+
+def default_config():
+    """The defaults tree every experiment config is overlaid on.
+
+    Mirrors the coverage of the reference defaults (ref: imaginaire/config.py:80-150)
+    with TPU-native runtime knobs replacing cudnn/apex/DDP ones.
+    """
+    return AttrDict(
+        # -- logging / snapshot cadence (ref: config.py:82-93)
+        image_save_iter=5000,
+        image_display_iter=500,
+        metrics_iter=None,
+        metrics_epoch=None,
+        snapshot_save_iter=5000,
+        snapshot_save_epoch=5,
+        max_epoch=200,
+        max_iter=1000000,
+        logging_iter=100,
+        speed_benchmark=False,
+        checkpoints_to_keep=3,
+        trainer=AttrDict(
+            type="imaginaire_tpu.trainers.base",
+            model_average=False,
+            model_average_beta=0.9999,
+            model_average_start_iteration=1000,
+            model_average_batch_norm_estimation_iteration=30,
+            model_average_remove_sn=True,
+            image_to_tensorboard=False,
+            hparam_to_tensorboard=False,
+            distributed_data_parallel="jit",  # jit-sharded DP (replaces pytorch/apex DDP)
+            delay_allreduce=True,  # accepted for config parity; XLA fuses collectives itself
+            gan_relativistic=False,
+            gen_step=1,
+            dis_step=1,
+            gan_mode="hinge",
+            # bf16 matmul/conv compute with fp32 params replaces apex AMP O1.
+            mixed_precision=AttrDict(enabled=False, compute_dtype="bfloat16"),
+            loss_weight=AttrDict(),
+            init=AttrDict(type="xavier", gain=0.02),
+            grad_clip_norm=None,
+        ),
+        gen=AttrDict(type="imaginaire_tpu.models.generators.dummy"),
+        dis=AttrDict(type="imaginaire_tpu.models.discriminators.dummy"),
+        gen_opt=AttrDict(
+            type="adam",
+            fused_opt=False,
+            lr=0.0001,
+            adam_beta1=0.0,
+            adam_beta2=0.999,
+            eps=1e-8,
+            lr_policy=AttrDict(iteration_mode=False, type="step", step_size=10000000, gamma=1.0),
+        ),
+        dis_opt=AttrDict(
+            type="adam",
+            fused_opt=False,
+            lr=0.0001,
+            adam_beta1=0.0,
+            adam_beta2=0.999,
+            eps=1e-8,
+            lr_policy=AttrDict(iteration_mode=False, type="step", step_size=10000000, gamma=1.0),
+        ),
+        data=AttrDict(
+            name="dummy",
+            type="imaginaire_tpu.data.images",
+            num_workers=0,
+            prefetch=2,
+        ),
+        test_data=AttrDict(
+            name="dummy",
+            type="imaginaire_tpu.data.images",
+            num_workers=0,
+        ),
+        # -- TPU runtime (replaces ref cudnn/local_rank blocks, config.py:143-150)
+        runtime=AttrDict(
+            mesh=AttrDict(axes=["data"], shape=None),  # shape None => all devices on 'data'
+            param_dtype="float32",
+            seed=2,
+            deterministic=False,
+        ),
+        pretrained_weight=None,
+        inference_args=AttrDict(),
+    )
+
+
+class Config(AttrDict):
+    """Load an experiment config: defaults <- yaml overlay (+ ``common`` broadcast).
+
+    ref: imaginaire/config.py:73-183.
+    """
+
+    def __init__(self, filename=None, overrides=None):
+        super().__init__(default_config())
+        if filename is not None:
+            user = load_yaml(filename)
+            if user:
+                recursive_update(self, user)
+        if overrides:
+            recursive_update(self, overrides)
+        # Broadcast the `common:` section into gen and dis configs
+        # (ref: imaginaire/config.py:173-177).
+        if "common" in self:
+            common = self["common"]
+            for section in ("gen", "dis"):
+                if section in self:
+                    for key, value in common.items():
+                        if key not in self[section]:
+                            self[section][key] = copy.deepcopy(value)
+        self["source_filename"] = str(filename) if filename is not None else None
+
+
+def cfg_get(cfg, key, default=None):
+    """`getattr(cfg, key, default)` idiom used pervasively by the reference
+    (ref: generators/spade.py:40-42)."""
+    try:
+        return cfg[key]
+    except (KeyError, TypeError):
+        return default

@@ -1,0 +1,19 @@
+"""TPU-native equivalents of the reference's CUDA extensions.
+
+Reference ops (ref: imaginaire/third_party/):
+  resample2d  — flow-based backward warping (resample2d_kernel.cu)
+  channelnorm — per-pixel L-p norm across channels (channelnorm_kernel.cu)
+  correlation — FlowNetC cost volume (correlation_cuda_kernel.cu)
+
+Each op has a pure-jnp implementation (differentiable; XLA autodiff turns
+the gather-style forward into the scatter-add backward the CUDA code does
+with atomicAdd) and a Pallas TPU kernel for the forward hot path wired in
+via custom_vjp. ``implementation='auto'`` picks Pallas on TPU, jnp
+elsewhere.
+"""
+
+from imaginaire_tpu.ops.resample2d import resample2d
+from imaginaire_tpu.ops.channelnorm import channelnorm
+from imaginaire_tpu.ops.correlation import correlation
+
+__all__ = ["resample2d", "channelnorm", "correlation"]

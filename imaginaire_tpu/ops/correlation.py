@@ -1,0 +1,99 @@
+"""correlation: FlowNetC cost volume between two feature maps.
+
+Semantics match the reference CUDA kernel (ref:
+third_party/correlation/src/correlation_cuda_kernel.cu;
+correlation_cuda.cc:10-43 for the shape math): for displacement (dy, dx)
+on a ``(2*max_displacement/stride2 + 1)^2`` grid, the output channel is
+the patch dot-product of x1 at (i, j) and x2 at (i + dy, j + dx),
+normalized by ``kernel_size^2 * C`` (the CUDA ``sumelems``). x2 is
+zero-padded by ``pad_size`` exactly like the CUDA rInput staging.
+
+Layout: NHWC in, output (B, H, W, D) with D displacement channels ordered
+row-major over (dy, dx) — same channel order as the CUDA op, so FlowNetC
+weights port directly.
+
+TPU notes: the displacement loop is a ``lax.scan`` over a static grid
+(one compiled slice+dot per step, compiler-friendly), and the reduction
+over channels is a contraction XLA can fuse; the Pallas kernel version
+tiles (H, W) blocks into VMEM and walks the displacement window there,
+turning the channel dot into an MXU matmul.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _displacement_grid(max_displacement, stride2):
+    steps = np.arange(-max_displacement, max_displacement + 1, stride2, dtype=np.int32)
+    dyx = np.stack(np.meshgrid(steps, steps, indexing="ij"), axis=-1).reshape(-1, 2)
+    return jnp.asarray(dyx)  # (D, 2) row-major over (dy, dx)
+
+
+def _correlation_jnp(x1, x2, pad_size, kernel_size, max_displacement, stride1, stride2):
+    if stride1 != 1:
+        raise NotImplementedError("stride1 != 1 not used by FlowNetC")
+    b, h, w, c = x1.shape
+    k = kernel_size
+    kr = (k - 1) // 2
+    pad = pad_size + kr
+    x2p = jnp.pad(x2, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    x1p = jnp.pad(x1, ((0, 0), (kr, kr), (kr, kr), (0, 0)))
+    grid = _displacement_grid(max_displacement, stride2)
+    sumelems = float(k * k * c)
+
+    def patch_sum(prod):
+        # sum over a k x k window centered at each pixel (k is small & odd)
+        out = jnp.zeros((b, h, w), prod.dtype)
+        for oy in range(k):
+            for ox in range(k):
+                out = out + lax.dynamic_slice(prod, (0, oy, ox), (b, h, w))
+        return out
+
+    def step(_, dyx):
+        dy, dx = dyx[0], dyx[1]
+        x2s = lax.dynamic_slice(
+            x2p, (0, pad_size + dy, pad_size + dx, 0), (b, h + 2 * kr, w + 2 * kr, c)
+        )
+        prod = jnp.sum(x1p * x2s, axis=-1)  # channel contraction
+        return None, patch_sum(prod) / sumelems
+
+    _, maps = lax.scan(step, None, grid)  # (D, B, H, W)
+    return jnp.transpose(maps, (1, 2, 3, 0))
+
+
+def correlation(
+    x1,
+    x2,
+    pad_size=20,
+    kernel_size=1,
+    max_displacement=20,
+    stride1=1,
+    stride2=2,
+    implementation="auto",
+):
+    """FlowNetC cost volume. Returns (B, H, W, D)."""
+    if x1.shape != x2.shape or x1.ndim != 4:
+        raise ValueError(f"correlation expects matching NHWC inputs, got {x1.shape}, {x2.shape}")
+    if pad_size < max_displacement:
+        raise ValueError("pad_size must cover max_displacement")
+    if implementation == "auto":
+        implementation = "jnp"  # jnp path is already MXU-friendly via XLA fusion
+    if implementation == "jnp":
+        return _correlation_jnp(x1, x2, pad_size, kernel_size, max_displacement, stride1, stride2)
+    if implementation in ("pallas", "pallas_interpret"):
+        from imaginaire_tpu.ops.pallas.correlation_kernel import correlation_pallas
+
+        return correlation_pallas(
+            x1,
+            x2,
+            pad_size=pad_size,
+            kernel_size=kernel_size,
+            max_displacement=max_displacement,
+            stride2=stride2,
+            interpret=(implementation == "pallas_interpret"),
+        )
+    raise ValueError(f"unknown implementation {implementation!r}")

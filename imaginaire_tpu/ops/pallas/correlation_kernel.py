@@ -1,0 +1,67 @@
+"""FlowNetC cost-volume Pallas kernel.
+
+Grid = (B, n_dy): each program computes the (H, W, n_dx) slab of the cost
+volume for one vertical displacement. The padded second feature map sits
+in VMEM; each dx step is a ``pl.ds`` shifted window, an elementwise
+product with x1 and a channel reduction — the displacement walk reuses
+the x1 block n_dx times from VMEM, which is the data reuse the CUDA
+kernel gets from its shared-memory rInput staging
+(ref: third_party/correlation/src/correlation_cuda_kernel.cu).
+
+kernel_size == 1 only (the FlowNetC configuration; the jnp path in
+ops/correlation.py supports general kernel sizes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+
+def _kernel(h, w, c, n_dx, stride2, x1_ref, x2p_ref, o_ref):
+    # x1_ref: (1, H, W, C); x2p_ref: (1, H+2p, W+2p, C); o_ref: (1, 1, H, W, n_dx)
+    # program_id(1) = dy index; the vertical offset into x2p is dyi * stride2.
+    dyi = pl.program_id(1)
+    x1 = x1_ref[0].astype(jnp.float32)
+    inv = 1.0 / c
+
+    def body(dxi, _):
+        win = x2p_ref[0, pl.ds(dyi * stride2, h), pl.ds(dxi * stride2, w), :]
+        corr = jnp.sum(x1 * win.astype(jnp.float32), axis=-1) * inv
+        o_ref[0, 0, :, :, pl.ds(dxi, 1)] = corr[..., None].astype(o_ref.dtype)
+        return 0
+
+    lax.fori_loop(0, n_dx, body, 0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("pad_size", "kernel_size", "max_displacement", "stride2", "interpret")
+)
+def correlation_pallas(x1, x2, pad_size=20, kernel_size=1, max_displacement=20, stride2=2, interpret=False):
+    if kernel_size != 1:
+        raise NotImplementedError("pallas correlation kernel supports kernel_size=1 (FlowNetC)")
+    b, h, w, c = x1.shape
+    n_d = 2 * (max_displacement // stride2) + 1
+    x2p = jnp.pad(x2, ((0, 0), (pad_size, pad_size), (pad_size, pad_size), (0, 0)))
+    # The displacement window starts at pad_size - max_displacement.
+    off = pad_size - max_displacement
+    x2p = x2p[:, off:, off:, :]
+    out = pl.pallas_call(
+        functools.partial(_kernel, h, w, c, n_d, stride2),
+        out_shape=jax.ShapeDtypeStruct((b, n_d, h, w, n_d), x1.dtype),
+        grid=(b, n_d),
+        in_specs=[
+            pl.BlockSpec((1, h, w, c), lambda bi, di: (bi, 0, 0, 0)),
+            pl.BlockSpec(
+                (1, x2p.shape[1], x2p.shape[2], c), lambda bi, di: (bi, 0, 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, 1, h, w, n_d), lambda bi, di: (bi, di, 0, 0, 0)),
+        interpret=interpret,
+    )(x1, x2p)
+    # (B, n_dy, H, W, n_dx) -> (B, H, W, n_dy * n_dx) row-major over (dy, dx)
+    return jnp.transpose(out, (0, 2, 3, 1, 4)).reshape(b, h, w, n_d * n_d)

@@ -1,0 +1,44 @@
+"""Distributed runtime: device mesh, sharding rules, collectives.
+
+Replaces the reference's torch.distributed layer (ref:
+imaginaire/utils/distributed.py, utils/trainer.py:193-216) with a
+jax.sharding Mesh + jit-partitioned train steps. Data parallelism is
+expressed as batch sharding over the ``data`` mesh axis; XLA inserts the
+gradient all-reduce (the moral equivalent of DDP's bucketed NCCL
+all-reduce) during SPMD partitioning, riding ICI within a host/pod slice
+and DCN across hosts.
+"""
+
+from imaginaire_tpu.parallel.mesh import (
+    create_mesh,
+    get_mesh,
+    set_mesh,
+    init_distributed,
+    get_rank,
+    get_world_size,
+    is_master,
+    master_only,
+    master_only_print,
+)
+from imaginaire_tpu.parallel.sharding import (
+    batch_sharding,
+    replicated_sharding,
+    shard_batch,
+    data_axis_size,
+)
+
+__all__ = [
+    "create_mesh",
+    "get_mesh",
+    "set_mesh",
+    "init_distributed",
+    "get_rank",
+    "get_world_size",
+    "is_master",
+    "master_only",
+    "master_only_print",
+    "batch_sharding",
+    "replicated_sharding",
+    "shard_batch",
+    "data_axis_size",
+]

@@ -1,0 +1,62 @@
+"""Collective helpers for code running inside shard_map/pjit contexts and
+host-level gathers for evaluation.
+
+Maps every collective call site of the reference (SURVEY.md section 5.8):
+  dist.reduce / all_reduce mean  -> pmean over the data axis
+  dist.all_gather (FID features) -> all_gather over the data axis /
+                                    process_allgather on host
+  dist.barrier                   -> multihost sync
+SyncBatchNorm's internal stats allreduce needs no explicit collective here
+(see parallel/sharding.py docstring).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from imaginaire_tpu.parallel.mesh import DATA_AXIS
+
+
+def pmean(x, axis_name=DATA_AXIS):
+    """Mean-allreduce inside a shard_map'd function (ref:
+    utils/distributed.py:73-81 dist_all_reduce_tensor)."""
+    return jax.lax.pmean(x, axis_name)
+
+
+def psum(x, axis_name=DATA_AXIS):
+    return jax.lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name=DATA_AXIS, axis=0, tiled=True):
+    """Gather shards along ``axis`` (ref: utils/distributed.py:84-93
+    dist_all_gather_tensor)."""
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def host_all_gather(x):
+    """Gather a per-process array across host processes (eval feature
+    gathering, ref: evaluation/common.py:68). Single-process: identity."""
+    if jax.process_count() == 1:
+        return x
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x, tiled=True)
+
+
+def barrier(name="barrier"):
+    """Cross-host rendezvous (ref: utils/io.py:120 dist.barrier)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def fold_in_data_rank(key, axis_name=DATA_AXIS):
+    """Per-replica RNG diversity inside a shard_map'd step: fold the data-axis
+    index into the key (ref rank-offset seeding, utils/trainer.py:90-110)."""
+    return jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+
+
+def tree_pmean(tree, axis_name=DATA_AXIS):
+    return jax.tree.map(lambda x: pmean(jnp.asarray(x), axis_name), tree)

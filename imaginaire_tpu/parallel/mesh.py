@@ -1,0 +1,104 @@
+"""Device mesh construction and process-level helpers.
+
+TPU-native replacement for ``init_dist / get_rank / get_world_size /
+master_only`` (ref: imaginaire/utils/distributed.py:11-58). A *process*
+here is a JAX host process (one per TPU VM host), not one-per-chip like
+the reference's one-process-per-GPU model; chips within a host are
+addressed through the mesh, not through processes.
+
+Mesh axes (all optional except ``data``):
+  data    : data parallelism — batch sharded, params replicated, grads psum'd.
+  model   : tensor parallelism headroom (unused by the 9 reference algorithms,
+            reserved so configs can request a 2-D mesh without code changes).
+  seq     : context/sequence parallelism for long video rollouts (frame axis
+            sharding with ppermute ring exchange of carried frames) — the
+            TPU-native extension filling SURVEY.md section 5.7.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_GLOBAL_MESH: Mesh | None = None
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def init_distributed(coordinator_address=None, num_processes=None, process_id=None):
+    """Initialize multi-host JAX (replaces dist.init_process_group, ref:
+    imaginaire/utils/distributed.py:11-17). No-op for single-process runs."""
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+
+def create_mesh(axes=("data",), shape=None, devices=None):
+    """Create a Mesh over the given logical axes.
+
+    ``shape=None`` puts every device on the first axis (pure DP, the
+    reference's only parallelism mode). An explicit shape like
+    ``{"data": 4, "model": 2}`` builds a 2-D mesh.
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    axes = tuple(axes)
+    if shape is None:
+        dims = [devices.size] + [1] * (len(axes) - 1)
+    else:
+        dims = [int(shape[a]) if (hasattr(shape, "__getitem__") and a in shape) else 1 for a in axes]
+        if int(np.prod(dims)) != devices.size:
+            raise ValueError(f"mesh shape {dims} != device count {devices.size}")
+    return Mesh(devices.reshape(dims), axes)
+
+
+def set_mesh(mesh):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+    return mesh
+
+
+def get_mesh():
+    global _GLOBAL_MESH
+    if _GLOBAL_MESH is None:
+        _GLOBAL_MESH = create_mesh()
+    return _GLOBAL_MESH
+
+
+def get_rank():
+    """Host-process index (ref: utils/distributed.py:20-26)."""
+    return jax.process_index()
+
+
+def get_world_size():
+    """Number of host processes (ref: utils/distributed.py:29-35)."""
+    return jax.process_count()
+
+
+def is_master():
+    return get_rank() == 0
+
+
+def master_only(func):
+    """Run only on process 0 (ref: utils/distributed.py:38-47)."""
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        if is_master():
+            return func(*args, **kwargs)
+        return None
+
+    return wrapper
+
+
+@master_only
+def master_only_print(*args, **kwargs):
+    """Print only on process 0 (ref: utils/distributed.py:55-58)."""
+    print(*args, **kwargs)

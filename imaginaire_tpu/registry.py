@@ -1,0 +1,66 @@
+"""String-keyed component registry — the framework's plugin architecture.
+
+The reference selects every component (generator, discriminator, trainer,
+dataset) by a dotted module path instantiated with importlib
+(ref: imaginaire/utils/trainer.py:61,95-98; utils/dataset.py:24). We keep
+that contract — config strings like ``imaginaire_tpu.models.generators.spade``
+resolve to a module exposing ``Generator``/``Discriminator``/``Trainer``/
+``Dataset`` — but back it with an explicit registry so components can also be
+registered under short names and third-party modules can plug in without
+sys.path tricks.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_REGISTRY: dict[str, object] = {}
+
+
+def register(key):
+    """Decorator: register a class/function under ``key``."""
+
+    def deco(obj):
+        _REGISTRY[key] = obj
+        return obj
+
+    return deco
+
+
+def resolve(type_string, attr):
+    """Resolve a config ``type`` string to the class named ``attr``.
+
+    Lookup order:
+      1. explicit registry key ``"<type_string>/<attr>"`` or ``type_string``
+      2. import ``type_string`` as a module and getattr(module, attr)
+         (the reference's importlib contract).
+
+    The reference's module names are accepted as aliases: a config written for
+    the reference (``imaginaire.generators.spade``) resolves to our module
+    (``imaginaire_tpu.models.generators.spade``).
+    """
+    key = f"{type_string}/{attr}"
+    if key in _REGISTRY:
+        return _REGISTRY[key]
+    if type_string in _REGISTRY:
+        return _REGISTRY[type_string]
+    module_name = _translate_reference_name(type_string)
+    module = importlib.import_module(module_name)
+    if not hasattr(module, attr):
+        raise AttributeError(f"module {module_name!r} (from config type {type_string!r}) has no {attr!r}")
+    return getattr(module, attr)
+
+
+def _translate_reference_name(name):
+    """Map reference config module paths onto ours for drop-in config reuse."""
+    mapping = {
+        "imaginaire.generators.": "imaginaire_tpu.models.generators.",
+        "imaginaire.discriminators.": "imaginaire_tpu.models.discriminators.",
+        "imaginaire.trainers.": "imaginaire_tpu.trainers.",
+        "imaginaire.datasets.": "imaginaire_tpu.data.",
+        "imaginaire.optimizers.": "imaginaire_tpu.optim.",
+    }
+    for old, new in mapping.items():
+        if name.startswith(old):
+            return new + name[len(old):]
+    return name

@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device virtual CPU mesh BEFORE jax import.
+
+The reference has no multi-device tests at all (SURVEY.md section 4); we
+test sharding logic for real by faking 8 host devices, which exercises
+exactly the SPMD partitioning and collectives that run on a TPU slice.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(0)

@@ -1,0 +1,54 @@
+import io
+
+from imaginaire_tpu.config import AttrDict, Config, cfg_get, load_yaml, recursive_update
+
+
+def test_attrdict_basic():
+    d = AttrDict({"a": 1, "b": {"c": 2}})
+    assert d.a == 1
+    assert d.b.c == 2
+    d.b.e = {"f": 3}
+    assert d.b.e.f == 3
+    assert isinstance(d.to_dict()["b"], dict)
+
+
+def test_recursive_update():
+    base = AttrDict({"a": {"x": 1, "y": 2}, "b": 3})
+    recursive_update(base, {"a": {"y": 5}, "c": [1, 2]})
+    assert base.a.x == 1 and base.a.y == 5 and base.b == 3
+    assert base.c == [1, 2]
+
+
+def test_float_resolver():
+    # YAML 1.1 would parse 1e-4 as a string; our loader must yield float
+    # (ref: imaginaire/config.py:154-164).
+    cfg = load_yaml(io.StringIO("lr: 1e-4\nother: 2.5e3\nname: e5\n"))
+    assert isinstance(cfg["lr"], float) and abs(cfg["lr"] - 1e-4) < 1e-12
+    assert isinstance(cfg["other"], float)
+    assert cfg["name"] == "e5"
+
+
+def test_config_defaults_and_overlay(tmp_path):
+    p = tmp_path / "exp.yaml"
+    p.write_text(
+        "max_iter: 7\n"
+        "gen:\n  type: imaginaire_tpu.models.generators.spade\n  num_filters: 32\n"
+        "common:\n  shared_flag: true\n"
+    )
+    cfg = Config(str(p))
+    assert cfg.max_iter == 7
+    assert cfg.max_epoch == 200  # default preserved
+    assert cfg.gen.num_filters == 32
+    # common broadcast into gen and dis (ref: config.py:173-177)
+    assert cfg.gen.shared_flag is True
+    assert cfg.dis.shared_flag is True
+    assert cfg_get(cfg.gen, "missing", 11) == 11
+
+
+def test_registry_reference_alias():
+    from imaginaire_tpu.registry import _translate_reference_name
+
+    assert (
+        _translate_reference_name("imaginaire.generators.spade")
+        == "imaginaire_tpu.models.generators.spade"
+    )
