@@ -1,0 +1,64 @@
+"""Composable layer library (ref: imaginaire/layers/).
+
+Blocks follow the reference's micro-DSL: an ``order`` string over
+{'C': conv/linear, 'N': activation norm, 'A': nonlinearity} arranges the
+sub-ops (ref: layers/conv.py:59-91), and conditional activation norms
+(AdaIN / SPADE / hyper-SPADE) receive their conditioning inputs as extra
+positional call arguments — the ``conditional`` flag protocol
+(ref: layers/__init__.py:5-20).
+
+TPU-first differences from the reference:
+  - NHWC layout; convs lower straight onto the MXU.
+  - Blocks are Flax linen modules; mutable state (BN stats, spectral-norm
+    power-iteration vectors) lives in the 'batch_stats' / 'spectral'
+    collections and threads functionally through train steps.
+  - 'batch' and 'sync_batch' norms are the same op: under a jit-sharded
+    global batch, plain batch statistics ARE cross-replica statistics
+    (see parallel/sharding.py).
+"""
+
+from imaginaire_tpu.layers.conv import (
+    Conv1dBlock,
+    Conv2dBlock,
+    Conv3dBlock,
+    HyperConv2dBlock,
+    LinearBlock,
+    MultiOutConv2dBlock,
+    PartialConv2dBlock,
+    PartialConv3dBlock,
+)
+from imaginaire_tpu.layers.residual import (
+    DownRes2dBlock,
+    HyperRes2dBlock,
+    MultiOutRes2dBlock,
+    PartialRes2dBlock,
+    PartialRes3dBlock,
+    Res1dBlock,
+    Res2dBlock,
+    Res3dBlock,
+    UpRes2dBlock,
+)
+from imaginaire_tpu.layers.non_local import NonLocal2dBlock
+from imaginaire_tpu.layers.misc import ApplyNoise
+
+__all__ = [
+    "Conv1dBlock",
+    "Conv2dBlock",
+    "Conv3dBlock",
+    "HyperConv2dBlock",
+    "LinearBlock",
+    "MultiOutConv2dBlock",
+    "PartialConv2dBlock",
+    "PartialConv3dBlock",
+    "Res1dBlock",
+    "Res2dBlock",
+    "Res3dBlock",
+    "UpRes2dBlock",
+    "DownRes2dBlock",
+    "HyperRes2dBlock",
+    "PartialRes2dBlock",
+    "PartialRes3dBlock",
+    "MultiOutRes2dBlock",
+    "NonLocal2dBlock",
+    "ApplyNoise",
+]

@@ -1,0 +1,385 @@
+"""Conv/Linear block family with the ``order`` micro-DSL.
+
+ref: imaginaire/layers/conv.py (``_BaseConvBlock``:14, forward
+dispatch:77-91, LinearBlock:138, ConvNdBlock:194-330,
+HyperConv2dBlock:438-590, PartialConv:593-1086, MultiOutConv2dBlock:851).
+
+A block = [weight-normalized conv] + [activation norm] + [nonlinearity],
+arranged by ``order`` ('CNA', 'NAC', ...). Conditional activation norms
+(AdaIN/SPADE) receive conditioning through extra positional call args.
+All blocks share the call contract ``block(x, *cond, training=False)``.
+
+Layout NHWC / NDHWC; kernels (spatial..., in, out).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax.numpy as jnp
+from flax import linen as nn
+from jax import lax
+
+from imaginaire_tpu.layers import hyper_ops
+from imaginaire_tpu.layers.activation_norm import CONDITIONAL_NORMS, get_activation_norm_layer
+from imaginaire_tpu.layers.misc import ApplyNoise
+from imaginaire_tpu.layers.nonlinearity import apply_nonlinearity, needs_prelu_param
+from imaginaire_tpu.layers.weight_norm import spectral_normalize, weight_normalize, demodulate
+from imaginaire_tpu.utils.init_weight import default_kernel_init
+
+_PAD_MODES = {"zeros": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}
+
+
+def _tuplify(v, n):
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v,) * n
+
+
+class _WeightNormedConv(nn.Module):
+    """N-d conv whose kernel passes through the configured weight norm."""
+
+    features: int
+    kernel_size: Sequence[int]
+    stride: Sequence[int]
+    padding: Sequence[int]
+    dilation: Sequence[int]
+    groups: int = 1
+    use_bias: bool = True
+    padding_mode: str = "zeros"
+    weight_norm_type: str = ""
+    weight_norm_params: Optional[dict] = None
+
+    @nn.compact
+    def __call__(self, x, training=False, style=None):
+        nd = len(self.kernel_size)
+        cin = x.shape[-1]
+        kshape = tuple(self.kernel_size) + (cin // self.groups, self.features)
+        kernel = self.param("kernel", default_kernel_init, kshape)
+        wn = self.weight_norm_type
+        p = dict(self.weight_norm_params or {})
+        if wn == "spectral":
+            kernel = spectral_normalize(self, kernel, training, eps=p.get("eps", 1e-12))
+        elif wn == "weight":
+            kernel = weight_normalize(self, kernel)
+        elif wn == "weight_demod":
+            if style is None:
+                raise ValueError("weight_demod conv requires a style input")
+            scale = nn.Dense(cin, name="demod_fc")(style) + 1.0
+            kernels = demodulate(kernel, scale, eps=p.get("eps", 1e-8))
+        elif wn not in ("", "none", None):
+            raise ValueError(f"unknown weight norm {wn!r}")
+
+        pads = [(0, 0)] + [(pad, pad) for pad in self.padding] + [(0, 0)]
+        if any(pad > 0 for pad in self.padding):
+            x = jnp.pad(x, pads, mode=_PAD_MODES[self.padding_mode])
+        if wn == "weight_demod":
+            out = hyper_ops.grouped_modulated_conv2d(
+                x, kernels, stride=self.stride[0], padding="VALID"
+            )
+        else:
+            out = lax.conv_general_dilated(
+                x,
+                kernel.astype(x.dtype),
+                window_strides=tuple(self.stride),
+                padding="VALID",
+                rhs_dilation=tuple(self.dilation),
+                dimension_numbers=_dim_numbers(nd),
+                feature_group_count=self.groups,
+            )
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.features,))
+            out = out + bias.astype(out.dtype)
+        return out
+
+
+def _dim_numbers(nd):
+    spatial = "DHW"[-nd:]
+    return (f"N{spatial}C", f"{spatial}IO", f"N{spatial}C")
+
+
+class _BaseConvBlock(nn.Module):
+    """Shared order-DSL engine (ref: layers/conv.py:14-135)."""
+
+    out_channels: int
+    kernel_size: Union[int, Sequence[int]] = 3
+    stride: Union[int, Sequence[int]] = 1
+    padding: Optional[Union[int, Sequence[int]]] = None
+    dilation: Union[int, Sequence[int]] = 1
+    groups: int = 1
+    bias: bool = True
+    padding_mode: str = "zeros"
+    weight_norm_type: str = ""
+    weight_norm_params: Optional[dict] = None
+    activation_norm_type: str = ""
+    activation_norm_params: Optional[dict] = None
+    nonlinearity: str = ""
+    apply_noise: bool = False
+    order: str = "CNA"
+    nd: int = 2
+
+    def _conv_module(self):
+        ks = _tuplify(self.kernel_size, self.nd)
+        dil = _tuplify(self.dilation, self.nd)
+        if self.padding is None:
+            pad = tuple(d * (k - 1) // 2 for k, d in zip(ks, dil))
+        else:
+            pad = _tuplify(self.padding, self.nd)
+        return _WeightNormedConv(
+            features=self.out_channels,
+            kernel_size=ks,
+            stride=_tuplify(self.stride, self.nd),
+            padding=pad,
+            dilation=dil,
+            groups=self.groups,
+            use_bias=self.bias,
+            padding_mode=self.padding_mode,
+            weight_norm_type=self.weight_norm_type,
+            weight_norm_params=self.weight_norm_params,
+            name="conv",
+        )
+
+    @property
+    def conditional(self):
+        return self.activation_norm_type in CONDITIONAL_NORMS
+
+    @nn.compact
+    def __call__(self, x, *cond_inputs, training=False, noise=None, style=None):
+        norm = get_activation_norm_layer(
+            self.activation_norm_type, self.activation_norm_params, name="norm"
+        )
+        prelu_alpha = (
+            self.param("prelu_alpha", nn.initializers.constant(0.25), ())
+            if needs_prelu_param(self.nonlinearity)
+            else None
+        )
+        for op in self.order:
+            if op == "C":
+                x = self._conv_module()(x, training=training, style=style)
+                if self.apply_noise:
+                    x = ApplyNoise(name="noise")(x, noise=noise)
+            elif op == "N":
+                if norm is not None:
+                    cond = cond_inputs if self.conditional else ()
+                    x = norm(x, *cond, training=training)
+            elif op == "A":
+                x = apply_nonlinearity(x, self.nonlinearity, prelu_alpha)
+            else:
+                raise ValueError(f"invalid order char {op!r} in {self.order!r}")
+        return x
+
+
+class Conv1dBlock(_BaseConvBlock):
+    nd: int = 1
+
+
+class Conv2dBlock(_BaseConvBlock):
+    nd: int = 2
+
+
+class Conv3dBlock(_BaseConvBlock):
+    nd: int = 3
+
+
+class LinearBlock(nn.Module):
+    """Dense + norm + activation with the same order DSL
+    (ref: layers/conv.py:138-192)."""
+
+    out_features: int
+    bias: bool = True
+    weight_norm_type: str = ""
+    activation_norm_type: str = ""
+    activation_norm_params: Optional[dict] = None
+    nonlinearity: str = ""
+    order: str = "CNA"
+
+    @nn.compact
+    def __call__(self, x, *cond_inputs, training=False):
+        norm = get_activation_norm_layer(
+            self.activation_norm_type, self.activation_norm_params, name="norm"
+        )
+        prelu_alpha = (
+            self.param("prelu_alpha", nn.initializers.constant(0.25), ())
+            if needs_prelu_param(self.nonlinearity)
+            else None
+        )
+        conditional = self.activation_norm_type in CONDITIONAL_NORMS
+        for op in self.order:
+            if op == "C":
+                kernel = self.param(
+                    "kernel", default_kernel_init, (x.shape[-1], self.out_features)
+                )
+                if self.weight_norm_type == "spectral":
+                    kernel = spectral_normalize(self, kernel, training)
+                elif self.weight_norm_type == "weight":
+                    kernel = weight_normalize(self, kernel)
+                x = x @ kernel.astype(x.dtype)
+                if self.bias:
+                    x = x + self.param(
+                        "bias", nn.initializers.zeros, (self.out_features,)
+                    ).astype(x.dtype)
+            elif op == "N":
+                if norm is not None:
+                    cond = cond_inputs if conditional else ()
+                    x = norm(x, *cond, training=training)
+            elif op == "A":
+                x = apply_nonlinearity(x, self.nonlinearity, prelu_alpha)
+        return x
+
+
+class HyperConv2dBlock(_BaseConvBlock):
+    """Conv block whose conv weights arrive at call time
+    (ref: layers/conv.py:438-590). ``conv_weights=(w, b)`` with
+    w: (B, kh, kw, cin, cout)."""
+
+    nd: int = 2
+
+    @nn.compact
+    def __call__(self, x, *cond_inputs, conv_weights=None, training=False, noise=None):
+        norm = get_activation_norm_layer(
+            self.activation_norm_type, self.activation_norm_params, name="norm"
+        )
+        for op in self.order:
+            if op == "C":
+                if conv_weights is None or conv_weights[0] is None:
+                    x = self._conv_module()(x, training=training)
+                else:
+                    w, b = conv_weights
+                    x = hyper_ops.per_sample_conv2d(
+                        x, w, b, stride=_tuplify(self.stride, 2)[0], padding="SAME"
+                    )
+                if self.apply_noise:
+                    x = ApplyNoise(name="noise")(x, noise=noise)
+            elif op == "N":
+                if norm is not None:
+                    cond = cond_inputs if self.conditional else ()
+                    x = norm(x, *cond, training=training)
+            elif op == "A":
+                x = apply_nonlinearity(x, self.nonlinearity, None)
+        return x
+
+
+class PartialConv2d(nn.Module):
+    """Mask-aware convolution (NVIDIA partial conv; ref:
+    layers/conv.py:927-1009). Returns (out, updated_mask)."""
+
+    features: int
+    kernel_size: Union[int, Sequence[int]] = 3
+    stride: int = 1
+    use_bias: bool = True
+    multi_channel: bool = False
+    eps: float = 1e-8
+    nd: int = 2
+
+    @nn.compact
+    def __call__(self, x, mask=None, training=False):
+        ks = _tuplify(self.kernel_size, self.nd)
+        cin = x.shape[-1]
+        kernel = self.param("kernel", default_kernel_init, ks + (cin, self.features))
+        if mask is None:
+            mask = jnp.ones(x.shape[:-1] + ((cin,) if self.multi_channel else (1,)), x.dtype)
+        dn = _dim_numbers(self.nd)
+        strides = _tuplify(self.stride, self.nd)
+        pad = [((k - 1) // 2, (k - 1) // 2) for k in ks]
+        mask_cin = cin if self.multi_channel else 1
+        ones_kernel = jnp.ones(ks + (mask_cin, 1), x.dtype)
+        win_size = float(jnp.prod(jnp.asarray(ks))) * mask_cin
+        mask_sum = lax.conv_general_dilated(
+            mask, ones_kernel, strides, pad, dimension_numbers=dn
+        )
+        out = lax.conv_general_dilated(
+            x * (mask if self.multi_channel else mask),
+            kernel.astype(x.dtype),
+            strides,
+            pad,
+            dimension_numbers=dn,
+        )
+        valid = mask_sum > 0
+        ratio = jnp.where(valid, win_size / jnp.maximum(mask_sum, self.eps), 0.0)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.features,))
+            out = (out * ratio + bias.astype(out.dtype)) * valid
+        else:
+            out = out * ratio
+        return out, valid.astype(x.dtype)
+
+
+class _BasePartialConvBlock(nn.Module):
+    """Partial-conv block with order DSL; threads (x, mask) pairs
+    (ref: layers/conv.py:593-700)."""
+
+    out_channels: int
+    kernel_size: Union[int, Sequence[int]] = 3
+    stride: int = 1
+    bias: bool = True
+    multi_channel: bool = False
+    activation_norm_type: str = ""
+    activation_norm_params: Optional[dict] = None
+    nonlinearity: str = ""
+    order: str = "CNA"
+    nd: int = 2
+
+    @nn.compact
+    def __call__(self, x, *cond_inputs, mask_in=None, training=False):
+        norm = get_activation_norm_layer(
+            self.activation_norm_type, self.activation_norm_params, name="norm"
+        )
+        conditional = self.activation_norm_type in CONDITIONAL_NORMS
+        mask = mask_in
+        for op in self.order:
+            if op == "C":
+                x, mask = PartialConv2d(
+                    features=self.out_channels,
+                    kernel_size=self.kernel_size,
+                    stride=self.stride,
+                    use_bias=self.bias,
+                    multi_channel=self.multi_channel,
+                    nd=self.nd,
+                    name="conv",
+                )(x, mask, training=training)
+            elif op == "N":
+                if norm is not None:
+                    cond = cond_inputs if conditional else ()
+                    x = norm(x, *cond, training=training)
+            elif op == "A":
+                x = apply_nonlinearity(x, self.nonlinearity, None)
+        return x, mask
+
+
+class PartialConv2dBlock(_BasePartialConvBlock):
+    nd: int = 2
+
+
+class PartialConv3dBlock(_BasePartialConvBlock):
+    nd: int = 3
+
+
+class PartialConv3d(PartialConv2d):
+    nd: int = 3
+
+
+class MultiOutConv2dBlock(_BaseConvBlock):
+    """Conv block that also returns the pre-nonlinearity features
+    (ref: layers/conv.py:851-924)."""
+
+    nd: int = 2
+
+    @nn.compact
+    def __call__(self, x, *cond_inputs, training=False, noise=None):
+        norm = get_activation_norm_layer(
+            self.activation_norm_type, self.activation_norm_params, name="norm"
+        )
+        pre_act = x
+        for op in self.order:
+            if op == "C":
+                x = self._conv_module()(x, training=training)
+                if self.apply_noise:
+                    x = ApplyNoise(name="noise")(x, noise=noise)
+            elif op == "N":
+                if norm is not None:
+                    cond = cond_inputs if self.conditional else ()
+                    x = norm(x, *cond, training=training)
+            elif op == "A":
+                pre_act = x
+                x = apply_nonlinearity(x, self.nonlinearity, None)
+        return x, pre_act

@@ -1,0 +1,54 @@
+"""Per-sample ("hyper") convolution: weights are runtime inputs.
+
+The reference loops over the batch applying F.conv2d per sample
+(ref: layers/conv.py:545-590). Here a single ``vmap`` over
+(sample, kernel) pairs produces one batched XLA conv — the per-sample
+loop disappears into the compiler and the MXU sees full tiles.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _conv2d_single(x, w, stride=1, padding="SAME", dilation=1):
+    # x: (H, W, Cin), w: (kh, kw, Cin, Cout)
+    out = lax.conv_general_dilated(
+        x[None],
+        w,
+        window_strides=(stride, stride),
+        padding=padding,
+        rhs_dilation=(dilation, dilation),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return out[0]
+
+
+def per_sample_conv2d(x, w, b=None, stride=1, padding="SAME", dilation=1):
+    """x: (B, H, W, Cin); w: (B, kh, kw, Cin, Cout); b: (B, Cout) or None."""
+    out = jax.vmap(lambda xi, wi: _conv2d_single(xi, wi, stride, padding, dilation))(x, w)
+    if b is not None:
+        out = out + b[:, None, None, :]
+    return out
+
+
+def grouped_modulated_conv2d(x, w, stride=1, padding="SAME"):
+    """Weight-demodulated conv: per-sample kernels (B, kh, kw, Cin, Cout)
+    applied as one grouped conv (StyleGAN2 trick, ref:
+    layers/weight_norm.py:14-68)."""
+    b, h, wd, cin = x.shape
+    _, kh, kw, _, cout = w.shape
+    x_g = jnp.transpose(x, (1, 2, 0, 3)).reshape(1, h, wd, b * cin)
+    w_g = jnp.transpose(w, (1, 2, 0, 3, 4)).reshape(kh, kw, cin, b * cout)
+    out = lax.conv_general_dilated(
+        x_g,
+        w_g,
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=b,
+    )
+    oh, ow = out.shape[1:3]
+    return jnp.transpose(out.reshape(oh, ow, b, cout), (2, 0, 1, 3))

@@ -1,0 +1,26 @@
+"""Noise injection (ref: imaginaire/layers/misc.py:9-30)."""
+
+from __future__ import annotations
+
+import jax
+from flax import linen as nn
+
+
+class ApplyNoise(nn.Module):
+    """StyleGAN-style additive noise with a learned scalar weight.
+
+    ``noise=None`` draws from the module's 'noise' RNG stream; passing an
+    explicit noise map reproduces a fixed draw (inference determinism).
+    If no stream and no explicit noise, the layer is a no-op (eval mode).
+    """
+
+    @nn.compact
+    def __call__(self, x, noise=None):
+        w = self.param("weight", nn.initializers.zeros, ())
+        if noise is None:
+            if self.has_rng("noise"):
+                key = self.make_rng("noise")
+                noise = jax.random.normal(key, x.shape[:-1] + (1,), x.dtype)
+            else:
+                return x
+        return x + w * noise

@@ -1,0 +1,43 @@
+"""SAGAN self-attention block (ref: imaginaire/layers/non_local.py:13-79).
+
+theta/phi/g 1x1 convs, attention over down-pooled keys/values, learned
+scalar gate gamma initialized at 0. The attention einsums are plain
+matmuls — MXU work — and XLA fuses the softmax chain.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from imaginaire_tpu.layers.conv import Conv2dBlock
+
+
+class NonLocal2dBlock(nn.Module):
+    scale: bool = True
+    clamp: bool = False
+    weight_norm_type: str = "spectral"
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        b, h, w, c = x.shape
+        ch = max(c // 8, 1)
+        cg = max(c // 2, 1)
+        conv = lambda out, name: Conv2dBlock(  # noqa: E731
+            out_channels=out,
+            kernel_size=1,
+            padding=0,
+            weight_norm_type=self.weight_norm_type,
+            order="C",
+            name=name,
+        )
+        theta = conv(ch, "theta")(x, training=training).reshape(b, h * w, ch)
+        phi = conv(ch, "phi")(x, training=training)
+        phi = nn.max_pool(phi, (2, 2), strides=(2, 2)).reshape(b, -1, ch)
+        g = conv(cg, "g")(x, training=training)
+        g = nn.max_pool(g, (2, 2), strides=(2, 2)).reshape(b, -1, cg)
+        attn = nn.softmax(jnp.einsum("bnc,bmc->bnm", theta, phi), axis=-1)
+        y = jnp.einsum("bnm,bmc->bnc", attn, g).reshape(b, h, w, cg)
+        y = conv(c, "out")(y, training=training)
+        gamma = self.param("gamma", nn.initializers.zeros, ())
+        return x + gamma * y

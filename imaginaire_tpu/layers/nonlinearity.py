@@ -1,0 +1,30 @@
+"""Nonlinearity factory (ref: imaginaire/layers/nonlinearity.py:8-37)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+VALID = ("", "none", "relu", "leakyrelu", "prelu", "tanh", "sigmoid", "softmax")
+
+
+def apply_nonlinearity(x, kind, prelu_alpha=None):
+    if kind in ("", "none", None):
+        return x
+    if kind == "relu":
+        return nn.relu(x)
+    if kind == "leakyrelu":
+        return nn.leaky_relu(x, negative_slope=0.2)
+    if kind == "prelu":
+        return jnp.where(x >= 0, x, prelu_alpha * x)
+    if kind == "tanh":
+        return jnp.tanh(x)
+    if kind == "sigmoid":
+        return nn.sigmoid(x)
+    if kind == "softmax":
+        return nn.softmax(x, axis=-1)
+    raise ValueError(f"unknown nonlinearity {kind!r}")
+
+
+def needs_prelu_param(kind):
+    return kind == "prelu"

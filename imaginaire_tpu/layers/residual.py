@@ -1,0 +1,341 @@
+"""Residual block family (ref: imaginaire/layers/residual.py).
+
+A residual block = two conv blocks on the main branch + a learned 1x1
+shortcut when channel counts differ (ref: residual.py:16-151). The
+``order`` string covers both main-branch convs ('CNACNA', 'NACNAC', or
+'pre_act' alias); conditional norms thread through both convs and the
+shortcut norm exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from imaginaire_tpu.layers.conv import (
+    Conv1dBlock,
+    Conv2dBlock,
+    Conv3dBlock,
+    HyperConv2dBlock,
+    MultiOutConv2dBlock,
+    PartialConv2dBlock,
+    PartialConv3dBlock,
+)
+
+_CONV_BLOCKS = {1: Conv1dBlock, 2: Conv2dBlock, 3: Conv3dBlock}
+
+
+def _split_order(order):
+    if order == "pre_act":
+        order = "NACNAC"
+    if len(order) not in (4, 5, 6):
+        raise ValueError(f"residual order must have 4-6 chars, got {order!r}")
+    half = (len(order) + 1) // 2
+    return order[:half], order[half:]
+
+
+class _BaseResBlock(nn.Module):
+    out_channels: int
+    kernel_size: Union[int, Sequence[int]] = 3
+    stride: int = 1
+    dilation: int = 1
+    padding: Optional[int] = None
+    bias: bool = True
+    padding_mode: str = "zeros"
+    weight_norm_type: str = ""
+    weight_norm_params: Optional[dict] = None
+    activation_norm_type: str = ""
+    activation_norm_params: Optional[dict] = None
+    skip_activation_norm: bool = True
+    nonlinearity: str = "leakyrelu"
+    apply_noise: bool = False
+    hidden_channels_equal_out_channels: bool = False
+    order: str = "CNACNA"
+    learn_shortcut: Optional[bool] = None
+    # up/down sampling hooks (overridden by Up/Down variants)
+    upsample: bool = False
+    downsample: bool = False
+    nd: int = 2
+
+    def _scale_up(self, x):
+        if not self.upsample:
+            return x
+        b, h, w, c = x.shape
+        return jax.image.resize(x, (b, 2 * h, 2 * w, c), method="nearest")
+
+    def _scale_down(self, x):
+        if not self.downsample:
+            return x
+        return nn.avg_pool(x, (2, 2), strides=(2, 2))
+
+    @nn.compact
+    def __call__(self, x, *cond_inputs, training=False):
+        conv_cls = _CONV_BLOCKS[self.nd]
+        order0, order1 = _split_order(self.order)
+        in_channels = x.shape[-1]
+        hidden = (
+            self.out_channels
+            if self.hidden_channels_equal_out_channels
+            else min(in_channels, self.out_channels)
+        )
+        learn_shortcut = (
+            self.learn_shortcut
+            if self.learn_shortcut is not None
+            else in_channels != self.out_channels
+        )
+        common = dict(
+            kernel_size=self.kernel_size,
+            padding=self.padding,
+            dilation=self.dilation,
+            bias=self.bias,
+            padding_mode=self.padding_mode,
+            weight_norm_type=self.weight_norm_type,
+            weight_norm_params=self.weight_norm_params,
+            activation_norm_type=self.activation_norm_type,
+            activation_norm_params=self.activation_norm_params,
+            nonlinearity=self.nonlinearity,
+            apply_noise=self.apply_noise,
+            nd=self.nd,
+        )
+        dx = conv_cls(out_channels=hidden, stride=1, order=order0, name="conv_0", **common)(
+            x, *cond_inputs, training=training
+        )
+        dx = self._scale_up(dx)
+        dx = conv_cls(
+            out_channels=self.out_channels, stride=self.stride, order=order1, name="conv_1", **common
+        )(dx, *cond_inputs, training=training)
+        dx = self._scale_down(dx)
+
+        xs = self._scale_up(x)
+        if learn_shortcut:
+            sc_common = dict(common)
+            sc_common["kernel_size"] = 1
+            sc_common["padding"] = 0
+            sc_common["dilation"] = 1
+            sc_common["apply_noise"] = False
+            if not self.skip_activation_norm:
+                sc_common["activation_norm_type"] = ""
+            sc_common["nonlinearity"] = ""
+            xs = conv_cls(
+                out_channels=self.out_channels, stride=self.stride, order="CN", name="conv_s", **sc_common
+            )(xs, *cond_inputs, training=training)
+        xs = self._scale_down(xs)
+        return xs + dx
+
+
+class Res1dBlock(_BaseResBlock):
+    nd: int = 1
+
+
+class Res2dBlock(_BaseResBlock):
+    nd: int = 2
+
+
+class Res3dBlock(_BaseResBlock):
+    nd: int = 3
+
+
+class UpRes2dBlock(_BaseResBlock):
+    """Residual block with nearest 2x upsampling between the convs and on
+    the shortcut (ref: residual.py:796-860)."""
+
+    upsample: bool = True
+    nd: int = 2
+
+
+class DownRes2dBlock(_BaseResBlock):
+    """Residual block with 2x average-pool downsampling
+    (ref: residual.py:648-712)."""
+
+    downsample: bool = True
+    nd: int = 2
+
+
+class HyperRes2dBlock(nn.Module):
+    """Residual block of hyper convs + (optionally hyper) SPADE norms whose
+    weights arrive at runtime (ref: residual.py:519-645; fs-vid2vid)."""
+
+    out_channels: int
+    kernel_size: Union[int, Sequence[int]] = 3
+    weight_norm_type: str = ""
+    activation_norm_type: str = "hyper_spatially_adaptive"
+    activation_norm_params: Optional[dict] = None
+    nonlinearity: str = "leakyrelu"
+    order: str = "CNACNA"
+
+    @nn.compact
+    def __call__(
+        self,
+        x,
+        *cond_inputs,
+        conv_weights=(None, None),
+        norm_weights=(None, None),
+        training=False,
+    ):
+        in_channels = x.shape[-1]
+        hidden = min(in_channels, self.out_channels)
+        common = dict(
+            kernel_size=self.kernel_size,
+            weight_norm_type=self.weight_norm_type,
+            activation_norm_type=self.activation_norm_type,
+            activation_norm_params=self.activation_norm_params,
+            nonlinearity=self.nonlinearity,
+        )
+        order0, order1 = _split_order(self.order)
+        dx = _HyperConvNorm(
+            out_channels=hidden, order=order0, name="conv_0", **common
+        )(x, *cond_inputs, conv_weights=conv_weights[0], norm_weights=norm_weights[0], training=training)
+        dx = _HyperConvNorm(
+            out_channels=self.out_channels, order=order1, name="conv_1", **common
+        )(dx, *cond_inputs, conv_weights=conv_weights[1], norm_weights=norm_weights[1], training=training)
+        if in_channels != self.out_channels:
+            xs = Conv2dBlock(
+                out_channels=self.out_channels,
+                kernel_size=1,
+                padding=0,
+                weight_norm_type=self.weight_norm_type,
+                order="C",
+                name="conv_s",
+            )(x, training=training)
+        else:
+            xs = x
+        return xs + dx
+
+
+class _HyperConvNorm(nn.Module):
+    """One hyper conv + hyper norm + activation step used by HyperRes2dBlock."""
+
+    out_channels: int
+    kernel_size: Union[int, Sequence[int]] = 3
+    weight_norm_type: str = ""
+    activation_norm_type: str = "hyper_spatially_adaptive"
+    activation_norm_params: Optional[dict] = None
+    nonlinearity: str = "leakyrelu"
+    order: str = "CNA"
+
+    @nn.compact
+    def __call__(self, x, *cond_inputs, conv_weights=None, norm_weights=None, training=False):
+        from imaginaire_tpu.layers import hyper_ops
+        from imaginaire_tpu.layers.activation_norm import get_activation_norm_layer
+        from imaginaire_tpu.layers.nonlinearity import apply_nonlinearity
+
+        norm = get_activation_norm_layer(
+            self.activation_norm_type, self.activation_norm_params, name="norm"
+        )
+        for op in self.order:
+            if op == "C":
+                if conv_weights is not None and conv_weights[0] is not None:
+                    w, b = conv_weights
+                    x = hyper_ops.per_sample_conv2d(x, w, b, padding="SAME")
+                else:
+                    x = Conv2dBlock(
+                        out_channels=self.out_channels,
+                        kernel_size=self.kernel_size,
+                        weight_norm_type=self.weight_norm_type,
+                        order="C",
+                        name="conv",
+                    )(x, training=training)
+            elif op == "N":
+                if norm is not None:
+                    if self.activation_norm_type == "hyper_spatially_adaptive":
+                        x = norm(x, *cond_inputs, norm_weights=norm_weights, training=training)
+                    else:
+                        x = norm(x, *cond_inputs, training=training)
+            elif op == "A":
+                x = apply_nonlinearity(x, self.nonlinearity, None)
+        return x
+
+
+class _BasePartialResBlock(nn.Module):
+    """Partial-conv residual block threading (x, mask)
+    (ref: residual.py:947-1086)."""
+
+    out_channels: int
+    kernel_size: Union[int, Sequence[int]] = 3
+    multi_channel: bool = False
+    activation_norm_type: str = ""
+    activation_norm_params: Optional[dict] = None
+    nonlinearity: str = "leakyrelu"
+    order: str = "CNACNA"
+    nd: int = 2
+
+    @nn.compact
+    def __call__(self, x, *cond_inputs, mask_in=None, training=False):
+        block_cls = PartialConv2dBlock if self.nd == 2 else PartialConv3dBlock
+        in_channels = x.shape[-1]
+        hidden = min(in_channels, self.out_channels)
+        order0, order1 = _split_order(self.order)
+        common = dict(
+            kernel_size=self.kernel_size,
+            multi_channel=self.multi_channel,
+            activation_norm_type=self.activation_norm_type,
+            activation_norm_params=self.activation_norm_params,
+            nonlinearity=self.nonlinearity,
+            nd=self.nd,
+        )
+        dx, mask = block_cls(out_channels=hidden, order=order0, name="conv_0", **common)(
+            x, *cond_inputs, mask_in=mask_in, training=training
+        )
+        dx, mask = block_cls(out_channels=self.out_channels, order=order1, name="conv_1", **common)(
+            dx, *cond_inputs, mask_in=mask, training=training
+        )
+        if in_channels != self.out_channels:
+            xs, _ = block_cls(
+                out_channels=self.out_channels,
+                kernel_size=1,
+                multi_channel=self.multi_channel,
+                order="C",
+                nd=self.nd,
+                name="conv_s",
+            )(x, mask_in=mask_in, training=training)
+        else:
+            xs = x
+        return xs + dx, mask
+
+
+class PartialRes2dBlock(_BasePartialResBlock):
+    nd: int = 2
+
+
+class PartialRes3dBlock(_BasePartialResBlock):
+    nd: int = 3
+
+
+class MultiOutRes2dBlock(nn.Module):
+    """Residual block returning (out, pre-nonlinearity aux) from its second
+    conv (ref: residual.py:1157-1235)."""
+
+    out_channels: int
+    kernel_size: Union[int, Sequence[int]] = 3
+    activation_norm_type: str = ""
+    activation_norm_params: Optional[dict] = None
+    nonlinearity: str = "leakyrelu"
+    order: str = "CNACNA"
+
+    @nn.compact
+    def __call__(self, x, *cond_inputs, training=False):
+        in_channels = x.shape[-1]
+        hidden = min(in_channels, self.out_channels)
+        order0, order1 = _split_order(self.order)
+        common = dict(
+            kernel_size=self.kernel_size,
+            activation_norm_type=self.activation_norm_type,
+            activation_norm_params=self.activation_norm_params,
+            nonlinearity=self.nonlinearity,
+        )
+        dx, _ = MultiOutConv2dBlock(out_channels=hidden, order=order0, name="conv_0", **common)(
+            x, *cond_inputs, training=training
+        )
+        dx, aux = MultiOutConv2dBlock(
+            out_channels=self.out_channels, order=order1, name="conv_1", **common
+        )(dx, *cond_inputs, training=training)
+        if in_channels != self.out_channels:
+            xs = Conv2dBlock(
+                out_channels=self.out_channels, kernel_size=1, padding=0, order="C", name="conv_s"
+            )(x, training=training)
+        else:
+            xs = x
+        return xs + dx, aux

@@ -1,0 +1,83 @@
+"""Weight normalizations: spectral norm, weight norm, weight demodulation.
+
+ref: imaginaire/layers/weight_norm.py.
+
+Spectral norm is a stateful transform (power-iteration vector ``u``); in
+this functional framework ``u`` lives in the ``'spectral'`` variable
+collection of the owning module and is updated in-place only when the
+call runs with ``training=True`` and the collection is mutable — the same
+contract as torch's hook updating ``weight_u`` on forward. The
+``sigma``-normalized weight can be materialized for EMA checkpoints
+("SN collapse", ref: utils/model_average.py:183-197) by
+``imaginaire_tpu.utils.model_average.collapse_spectral_norm``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _l2_normalize(v, eps=1e-12):
+    return v / (jnp.linalg.norm(v) + eps)
+
+
+def power_iteration(w_mat, u, n_steps=1, eps=1e-12):
+    """One (or more) power-iteration steps. w_mat: (out, rest), u: (out,).
+
+    Returns (sigma, new_u). Gradients do not flow through u/v (matching
+    torch.nn.utils.spectral_norm's no_grad update)."""
+    w_ng = lax.stop_gradient(w_mat)
+    v = None
+    for _ in range(n_steps):
+        v = _l2_normalize(w_ng.T @ u, eps)
+        u = _l2_normalize(w_ng @ v, eps)
+    u = lax.stop_gradient(u)
+    v = lax.stop_gradient(v)
+    sigma = jnp.einsum("o,or,r->", u, w_mat, v)
+    return sigma, u
+
+
+def spectral_normalize(module, kernel, training, name="u", n_steps=1, eps=1e-12):
+    """Apply spectral normalization to ``kernel`` inside a linen module.
+
+    kernel layout: (..., out) — flax convention (spatial..., in, out).
+    The power-iteration matrix is (out, prod(rest)), matching torch's
+    view of (out, in*kh*kw) so ported sigmas agree.
+    """
+    out_ch = kernel.shape[-1]
+    w_mat = kernel.reshape(-1, out_ch).T  # (out, rest)
+    u_var = module.variable(
+        "spectral",
+        name,
+        lambda: _l2_normalize(
+            jnp.asarray(
+                # deterministic init; the first power iterations converge it
+                jnp.sin(jnp.arange(out_ch, dtype=jnp.float32) + 1.0)
+            )
+        ),
+    )
+    sigma, new_u = power_iteration(w_mat, u_var.value, n_steps=n_steps, eps=eps)
+    if training and not module.is_initializing():
+        u_var.value = new_u
+    return kernel / sigma
+
+
+def weight_normalize(module, kernel, name="g", eps=1e-12):
+    """Classic weight norm: kernel = g * v / ||v||, per output channel."""
+    out_ch = kernel.shape[-1]
+    g = module.param(name, lambda rng: jnp.linalg.norm(kernel.reshape(-1, out_ch), axis=0))
+    norm = jnp.linalg.norm(kernel.reshape(-1, out_ch), axis=0) + eps
+    return kernel * (g / norm)
+
+
+def demodulate(kernel, style, eps=1e-8):
+    """StyleGAN2 weight demodulation (ref: layers/weight_norm.py:14-68).
+
+    kernel: (kh, kw, in, out); style: (B, in) per-sample input scales.
+    Returns per-sample kernels (B, kh, kw, in, out), demodulated per
+    output channel.
+    """
+    w = kernel[None] * style[:, None, None, :, None]
+    d = jnp.sqrt(jnp.sum(w * w, axis=(1, 2, 3), keepdims=True) + eps)
+    return w / d

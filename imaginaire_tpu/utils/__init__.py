@@ -1,0 +1,1 @@
+"""Runtime utilities: init, meters, logging, EMA, misc tensor helpers."""

@@ -1,0 +1,66 @@
+"""Weight-init factory (ref: imaginaire/utils/init_weight.py:8-61).
+
+The reference applies ``weights_init(type, gain)`` to every module after
+construction; here the equivalent is a process-global default initializer
+that blocks read at ``param(...)`` creation time. The trainer factory sets
+it from ``cfg.trainer.init`` before calling ``model.init`` (same config
+surface: xavier / xavier_uniform / normal / kaiming / orthogonal / none).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from flax import linen as nn
+from jax import random
+
+_DEFAULT = {"type": "xavier", "gain": 0.02}
+
+
+def set_default_init(init_type="xavier", gain=0.02):
+    _DEFAULT["type"] = init_type or "none"
+    _DEFAULT["gain"] = gain
+
+
+def get_default_init():
+    return dict(_DEFAULT)
+
+
+def make_kernel_init(init_type=None, gain=None):
+    """Return a flax initializer fn for conv/dense kernels.
+
+    Fan computation follows torch's (kernel layout here is
+    (spatial..., in, out)): fan_in = in * prod(spatial), fan_out =
+    out * prod(spatial).
+    """
+    init_type = init_type if init_type is not None else _DEFAULT["type"]
+    gain = gain if gain is not None else _DEFAULT["gain"]
+
+    def init(key, shape, dtype=jnp.float32):
+        fan_in = math.prod(shape[:-1])
+        fan_out = shape[-1] * math.prod(shape[:-2]) if len(shape) > 1 else shape[-1]
+        if init_type in ("none", "", None):
+            # torch default: kaiming_uniform(a=sqrt(5)) == U(-1/sqrt(fan_in), +)
+            bound = 1.0 / math.sqrt(fan_in) if fan_in > 0 else 0.0
+            return random.uniform(key, shape, dtype, -bound, bound)
+        if init_type == "normal":
+            return gain * random.normal(key, shape, dtype)
+        if init_type == "xavier":
+            std = gain * math.sqrt(2.0 / (fan_in + fan_out))
+            return std * random.normal(key, shape, dtype)
+        if init_type == "xavier_uniform":
+            a = gain * math.sqrt(6.0 / (fan_in + fan_out))
+            return random.uniform(key, shape, dtype, -a, a)
+        if init_type == "kaiming":
+            std = gain * math.sqrt(2.0 / fan_in)
+            return std * random.normal(key, shape, dtype)
+        if init_type == "orthogonal":
+            return gain * nn.initializers.orthogonal()(key, shape, dtype)
+        raise ValueError(f"unknown init type {init_type!r}")
+
+    return init
+
+
+def default_kernel_init(key, shape, dtype=jnp.float32):
+    return make_kernel_init()(key, shape, dtype)

@@ -1,0 +1,241 @@
+"""Layer library tests: shapes, order DSL, conditional norms, weight norms."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from imaginaire_tpu.layers import (
+    ApplyNoise,
+    Conv2dBlock,
+    HyperConv2dBlock,
+    LinearBlock,
+    MultiOutConv2dBlock,
+    NonLocal2dBlock,
+    PartialConv2dBlock,
+    Res2dBlock,
+    UpRes2dBlock,
+    DownRes2dBlock,
+    MultiOutRes2dBlock,
+    PartialRes2dBlock,
+)
+from imaginaire_tpu.layers.activation_norm import (
+    AdaptiveNorm,
+    InstanceNorm,
+    LayerNorm2d,
+    SpatiallyAdaptiveNorm,
+)
+
+
+def init_and_apply(mod, *args, training=False, **kwargs):
+    key = jax.random.PRNGKey(0)
+    variables = mod.init(key, *args, training=training, **kwargs)
+    out = mod.apply(variables, *args, training=training, **kwargs)
+    return out, variables
+
+
+def test_conv2dblock_orders():
+    x = jnp.ones((2, 8, 8, 3))
+    for order in ["CNA", "NAC", "CAN", "C"]:
+        blk = Conv2dBlock(out_channels=4, kernel_size=3, activation_norm_type="instance",
+                          nonlinearity="relu", order=order)
+        out, _ = init_and_apply(blk, x)
+        assert out.shape == (2, 8, 8, 4), order
+
+
+def test_conv2dblock_stride_padding():
+    x = jnp.ones((1, 8, 8, 3))
+    blk = Conv2dBlock(out_channels=4, kernel_size=4, stride=2, padding=1)
+    out, _ = init_and_apply(blk, x)
+    assert out.shape == (1, 4, 4, 4)
+
+
+def test_conv2dblock_reflect_padding():
+    x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+    blk = Conv2dBlock(out_channels=2, kernel_size=3, padding_mode="reflect")
+    out, _ = init_and_apply(blk, x)
+    assert out.shape == (1, 4, 4, 2)
+
+
+def test_spectral_norm_updates_and_bounds():
+    x = jnp.ones((2, 6, 6, 3))
+    blk = Conv2dBlock(out_channels=8, kernel_size=3, weight_norm_type="spectral")
+    key = jax.random.PRNGKey(1)
+    variables = blk.init(key, x, training=False)
+    assert "spectral" in variables
+    # training=True must update u in the mutable collection
+    out, mutated = blk.apply(variables, x, training=True, mutable=["spectral"])
+    u_before = variables["spectral"]["conv"]["u"]
+    u_after = mutated["spectral"]["conv"]["u"]
+    assert not np.allclose(np.asarray(u_before), np.asarray(u_after))
+    # after several power iterations the spectral norm of the used kernel -> 1
+    for _ in range(50):
+        _, upd = blk.apply(variables, x, training=True, mutable=["spectral"])
+        variables = {**variables, "spectral": upd["spectral"]}
+    kernel = np.asarray(variables["params"]["conv"]["kernel"])
+    u = np.asarray(variables["spectral"]["conv"]["u"])
+    w = kernel.reshape(-1, kernel.shape[-1]).T
+    v = w.T @ u
+    v /= np.linalg.norm(v) + 1e-12
+    sigma = u @ w @ v
+    true_sigma = np.linalg.svd(w, compute_uv=False)[0]
+    assert abs(sigma - true_sigma) / true_sigma < 1e-3
+
+
+def test_linear_block():
+    x = jnp.ones((4, 10))
+    blk = LinearBlock(out_features=6, nonlinearity="relu", weight_norm_type="spectral")
+    out, _ = init_and_apply(blk, x)
+    assert out.shape == (4, 6)
+
+
+def test_adaptive_norm_broadcast():
+    x = jnp.ones((2, 4, 4, 6))
+    style = jnp.ones((2, 8))
+    norm = AdaptiveNorm()
+    out, _ = init_and_apply(norm, x, style)
+    assert out.shape == x.shape
+
+
+def test_spade_norm_resizes_label():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 6))
+    label = jnp.ones((2, 32, 32, 5))  # bigger than x: must be resized down
+    norm = SpatiallyAdaptiveNorm(num_filters=16, base_norm="instance")
+    out, variables = init_and_apply(norm, x, label)
+    assert out.shape == x.shape
+
+
+def test_spade_norm_multiple_conds():
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 8, 4))
+    c1 = jnp.ones((1, 8, 8, 3))
+    c2 = jnp.ones((1, 8, 8, 2))
+    norm = SpatiallyAdaptiveNorm(num_filters=8, base_norm="instance")
+    out, _ = init_and_apply(norm, x, c1, c2)
+    assert out.shape == x.shape
+
+
+def test_instance_norm_normalizes():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 3)) * 5 + 2
+    norm = InstanceNorm(affine=False)
+    out, _ = init_and_apply(norm, x)
+    m = np.asarray(out).mean(axis=(1, 2))
+    s = np.asarray(out).std(axis=(1, 2))
+    np.testing.assert_allclose(m, 0, atol=1e-4)
+    np.testing.assert_allclose(s, 1, atol=1e-2)
+
+
+def test_layer_norm_2d():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 3)) * 3 + 1
+    out, _ = init_and_apply(LayerNorm2d(affine=False), x)
+    flat = np.asarray(out).reshape(2, -1)
+    np.testing.assert_allclose(flat.mean(1), 0, atol=1e-4)
+    np.testing.assert_allclose(flat.std(1), 1, atol=1e-2)
+
+
+def test_res2dblock_shortcut():
+    x = jnp.ones((2, 8, 8, 3))
+    out, variables = init_and_apply(Res2dBlock(out_channels=5), x)
+    assert out.shape == (2, 8, 8, 5)
+    assert "conv_s" in variables["params"]  # learned shortcut for 3 -> 5
+    out2, variables2 = init_and_apply(Res2dBlock(out_channels=3), x)
+    assert "conv_s" not in variables2["params"]
+
+
+def test_res2dblock_spade_conditional():
+    x = jnp.ones((2, 8, 8, 4))
+    seg = jnp.ones((2, 8, 8, 3))
+    blk = Res2dBlock(
+        out_channels=6,
+        weight_norm_type="spectral",
+        activation_norm_type="spatially_adaptive",
+        activation_norm_params={"num_filters": 8, "activation_norm_type": "instance"},
+        order="NACNAC",
+    )
+    out, _ = init_and_apply(blk, x, seg)
+    assert out.shape == (2, 8, 8, 6)
+
+
+def test_up_down_res_blocks():
+    x = jnp.ones((1, 8, 8, 4))
+    up, _ = init_and_apply(UpRes2dBlock(out_channels=4), x)
+    assert up.shape == (1, 16, 16, 4)
+    down, _ = init_and_apply(DownRes2dBlock(out_channels=4), x)
+    assert down.shape == (1, 4, 4, 4)
+
+
+def test_partial_conv_block_mask_update():
+    x = jnp.ones((1, 6, 6, 3))
+    mask = jnp.zeros((1, 6, 6, 1)).at[:, 2:4, 2:4].set(1.0)
+    blk = PartialConv2dBlock(out_channels=4, kernel_size=3, nonlinearity="relu")
+    key = jax.random.PRNGKey(0)
+    variables = blk.init(key, x, mask_in=mask)
+    out, new_mask = blk.apply(variables, x, mask_in=mask)
+    assert out.shape == (1, 6, 6, 4)
+    # mask dilates by one pixel (3x3 window touches a valid pixel)
+    assert np.asarray(new_mask)[0, 1, 1, 0] == 1.0
+    assert np.asarray(new_mask)[0, 0, 0, 0] == 0.0
+
+
+def test_partial_res_block():
+    x = jnp.ones((1, 6, 6, 3))
+    mask = jnp.ones((1, 6, 6, 1))
+    blk = PartialRes2dBlock(out_channels=5, activation_norm_type="instance")
+    key = jax.random.PRNGKey(0)
+    variables = blk.init(key, x, mask_in=mask)
+    out, m = blk.apply(variables, x, mask_in=mask)
+    assert out.shape == (1, 6, 6, 5)
+
+
+def test_hyper_conv_block_per_sample_weights(rng):
+    x = jnp.asarray(rng.randn(2, 6, 6, 3).astype(np.float32))
+    w = jnp.asarray(rng.randn(2, 3, 3, 3, 4).astype(np.float32) * 0.1)
+    b = jnp.zeros((2, 4))
+    blk = HyperConv2dBlock(out_channels=4, kernel_size=3, nonlinearity="relu")
+    key = jax.random.PRNGKey(0)
+    variables = blk.init(key, x, conv_weights=(w, b))
+    out = blk.apply(variables, x, conv_weights=(w, b))
+    assert out.shape == (2, 6, 6, 4)
+    # per-sample: swapping kernels must change per-sample outputs
+    out_swapped = blk.apply(variables, x, conv_weights=(w[::-1], b))
+    assert not np.allclose(np.asarray(out)[0], np.asarray(out_swapped)[0])
+
+
+def test_multi_out_blocks():
+    x = jnp.ones((1, 8, 8, 3))
+    out, pre = init_and_apply(
+        MultiOutConv2dBlock(out_channels=4, nonlinearity="leakyrelu"), x
+    )[0]
+    assert out.shape == (1, 8, 8, 4) and pre.shape == (1, 8, 8, 4)
+    (out2, aux), _ = init_and_apply(
+        MultiOutRes2dBlock(out_channels=4, nonlinearity="leakyrelu"), x
+    )
+    assert out2.shape == (1, 8, 8, 4)
+
+
+def test_non_local_block():
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 8, 16))
+    out, variables = init_and_apply(NonLocal2dBlock(), x)
+    assert out.shape == x.shape
+    # gamma starts at 0 -> identity at init
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-6)
+
+
+def test_apply_noise():
+    x = jnp.ones((1, 4, 4, 2))
+    mod = ApplyNoise()
+    variables = mod.init({"params": jax.random.PRNGKey(0), "noise": jax.random.PRNGKey(1)}, x)
+    # weight starts at zero -> identity
+    out = mod.apply(variables, x, rngs={"noise": jax.random.PRNGKey(2)})
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_weight_demod_conv(rng):
+    from imaginaire_tpu.layers.conv import Conv2dBlock
+
+    x = jnp.asarray(rng.randn(2, 6, 6, 3).astype(np.float32))
+    style = jnp.asarray(rng.randn(2, 8).astype(np.float32))
+    blk = Conv2dBlock(out_channels=4, kernel_size=3, weight_norm_type="weight_demod")
+    key = jax.random.PRNGKey(0)
+    variables = blk.init(key, x, style=style)
+    out = blk.apply(variables, x, style=style)
+    assert out.shape == (2, 6, 6, 4)
