@@ -21,13 +21,17 @@ from flax import linen as nn
 from imaginaire_tpu.layers import hyper_ops
 
 
-def _resize_nearest(x, hw):
+def _resize(x, hw, method="nearest"):
     b, h, w, c = x.shape
     if (h, w) == tuple(hw):
         return x
     import jax
 
-    return jax.image.resize(x, (b, hw[0], hw[1], c), method="nearest")
+    return jax.image.resize(x, (b, hw[0], hw[1], c), method=method)
+
+
+def _resize_nearest(x, hw):
+    return _resize(x, hw, "nearest")
 
 
 class NoNorm(nn.Module):
@@ -185,9 +189,9 @@ class SpatiallyAdaptiveNorm(nn.Module):
             mask = None
             if isinstance(cond, (tuple, list)):
                 cond, mask = cond
-            cond = _resize_nearest(cond, hw)
+            cond = _resize(cond, hw, self.interpolation)
             if mask is not None:
-                mask = _resize_nearest(mask, hw)
+                mask = _resize(mask, hw, self.interpolation)
             if self.partial and mask is not None:
                 from imaginaire_tpu.layers.conv import PartialConv2d
 
@@ -322,6 +326,7 @@ def get_activation_norm_layer(norm_type, norm_params=None, name=None):
             base_norm=p.get("activation_norm_type", "sync_batch"),
             separate_projection=p.get("separate_projection", True),
             partial=p.get("partial", False),
+            interpolation=p.get("interpolation", "nearest"),
             **kw,
         )
     if norm_type == "hyper_spatially_adaptive":

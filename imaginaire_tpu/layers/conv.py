@@ -75,7 +75,8 @@ class _WeightNormedConv(nn.Module):
             x = jnp.pad(x, pads, mode=_PAD_MODES[self.padding_mode])
         if wn == "weight_demod":
             out = hyper_ops.grouped_modulated_conv2d(
-                x, kernels, stride=self.stride[0], padding="VALID"
+                x, kernels, stride=tuple(self.stride), padding="VALID",
+                dilation=tuple(self.dilation)
             )
         else:
             out = lax.conv_general_dilated(
@@ -235,14 +236,20 @@ class HyperConv2dBlock(_BaseConvBlock):
     nd: int = 2
 
     @nn.compact
-    def __call__(self, x, *cond_inputs, conv_weights=None, training=False, noise=None):
+    def __call__(self, x, *cond_inputs, conv_weights=None, training=False,
+                 noise=None, style=None):
         norm = get_activation_norm_layer(
             self.activation_norm_type, self.activation_norm_params, name="norm"
+        )
+        prelu_alpha = (
+            self.param("prelu_alpha", nn.initializers.constant(0.25), ())
+            if needs_prelu_param(self.nonlinearity)
+            else None
         )
         for op in self.order:
             if op == "C":
                 if conv_weights is None or conv_weights[0] is None:
-                    x = self._conv_module()(x, training=training)
+                    x = self._conv_module()(x, training=training, style=style)
                 else:
                     w, b = conv_weights
                     x = hyper_ops.per_sample_conv2d(
@@ -255,7 +262,7 @@ class HyperConv2dBlock(_BaseConvBlock):
                     cond = cond_inputs if self.conditional else ()
                     x = norm(x, *cond, training=training)
             elif op == "A":
-                x = apply_nonlinearity(x, self.nonlinearity, None)
+                x = apply_nonlinearity(x, self.nonlinearity, prelu_alpha)
         return x
 
 
@@ -288,7 +295,7 @@ class PartialConv2d(nn.Module):
             mask, ones_kernel, strides, pad, dimension_numbers=dn
         )
         out = lax.conv_general_dilated(
-            x * (mask if self.multi_channel else mask),
+            x * mask,
             kernel.astype(x.dtype),
             strides,
             pad,
@@ -325,6 +332,11 @@ class _BasePartialConvBlock(nn.Module):
             self.activation_norm_type, self.activation_norm_params, name="norm"
         )
         conditional = self.activation_norm_type in CONDITIONAL_NORMS
+        prelu_alpha = (
+            self.param("prelu_alpha", nn.initializers.constant(0.25), ())
+            if needs_prelu_param(self.nonlinearity)
+            else None
+        )
         mask = mask_in
         for op in self.order:
             if op == "C":
@@ -342,7 +354,7 @@ class _BasePartialConvBlock(nn.Module):
                     cond = cond_inputs if conditional else ()
                     x = norm(x, *cond, training=training)
             elif op == "A":
-                x = apply_nonlinearity(x, self.nonlinearity, None)
+                x = apply_nonlinearity(x, self.nonlinearity, prelu_alpha)
         return x, mask
 
 
@@ -365,14 +377,19 @@ class MultiOutConv2dBlock(_BaseConvBlock):
     nd: int = 2
 
     @nn.compact
-    def __call__(self, x, *cond_inputs, training=False, noise=None):
+    def __call__(self, x, *cond_inputs, training=False, noise=None, style=None):
         norm = get_activation_norm_layer(
             self.activation_norm_type, self.activation_norm_params, name="norm"
+        )
+        prelu_alpha = (
+            self.param("prelu_alpha", nn.initializers.constant(0.25), ())
+            if needs_prelu_param(self.nonlinearity)
+            else None
         )
         pre_act = x
         for op in self.order:
             if op == "C":
-                x = self._conv_module()(x, training=training)
+                x = self._conv_module()(x, training=training, style=style)
                 if self.apply_noise:
                     x = ApplyNoise(name="noise")(x, noise=noise)
             elif op == "N":
@@ -381,5 +398,5 @@ class MultiOutConv2dBlock(_BaseConvBlock):
                     x = norm(x, *cond, training=training)
             elif op == "A":
                 pre_act = x
-                x = apply_nonlinearity(x, self.nonlinearity, None)
+                x = apply_nonlinearity(x, self.nonlinearity, prelu_alpha)
         return x, pre_act

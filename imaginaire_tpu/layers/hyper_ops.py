@@ -34,19 +34,27 @@ def per_sample_conv2d(x, w, b=None, stride=1, padding="SAME", dilation=1):
     return out
 
 
-def grouped_modulated_conv2d(x, w, stride=1, padding="SAME"):
+def grouped_modulated_conv2d(x, w, stride=1, padding="SAME", dilation=1):
     """Weight-demodulated conv: per-sample kernels (B, kh, kw, Cin, Cout)
     applied as one grouped conv (StyleGAN2 trick, ref:
-    layers/weight_norm.py:14-68)."""
+    layers/weight_norm.py:14-68).
+
+    Group g of the grouped kernel must hold sample g's filters, so the
+    batch axis lands next to Cout (groups-major channel order) on both
+    the kernel and the output.
+    """
     b, h, wd, cin = x.shape
     _, kh, kw, _, cout = w.shape
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
     x_g = jnp.transpose(x, (1, 2, 0, 3)).reshape(1, h, wd, b * cin)
-    w_g = jnp.transpose(w, (1, 2, 0, 3, 4)).reshape(kh, kw, cin, b * cout)
+    w_g = jnp.transpose(w, (1, 2, 3, 0, 4)).reshape(kh, kw, cin, b * cout)
     out = lax.conv_general_dilated(
         x_g,
-        w_g,
-        window_strides=(stride, stride),
+        w_g.astype(x.dtype),
+        window_strides=stride,
         padding=padding,
+        rhs_dilation=dilation,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=b,
     )

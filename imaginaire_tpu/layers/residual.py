@@ -43,7 +43,9 @@ class _BaseResBlock(nn.Module):
     stride: int = 1
     dilation: int = 1
     padding: Optional[int] = None
-    bias: bool = True
+    # bool, or a [conv_0, conv_1, shortcut] list (ref SPADE passes
+    # bias=[True, True, False], generators/spade.py:262).
+    bias: Union[bool, Sequence[bool]] = True
     padding_mode: str = "zeros"
     weight_norm_type: str = ""
     weight_norm_params: Optional[dict] = None
@@ -86,11 +88,14 @@ class _BaseResBlock(nn.Module):
             if self.learn_shortcut is not None
             else in_channels != self.out_channels
         )
+        if isinstance(self.bias, (tuple, list)):
+            bias_0, bias_1, bias_s = self.bias
+        else:
+            bias_0 = bias_1 = bias_s = self.bias
         common = dict(
             kernel_size=self.kernel_size,
             padding=self.padding,
             dilation=self.dilation,
-            bias=self.bias,
             padding_mode=self.padding_mode,
             weight_norm_type=self.weight_norm_type,
             weight_norm_params=self.weight_norm_params,
@@ -100,12 +105,12 @@ class _BaseResBlock(nn.Module):
             apply_noise=self.apply_noise,
             nd=self.nd,
         )
-        dx = conv_cls(out_channels=hidden, stride=1, order=order0, name="conv_0", **common)(
-            x, *cond_inputs, training=training
-        )
+        dx = conv_cls(out_channels=hidden, stride=1, order=order0, bias=bias_0,
+                      name="conv_0", **common)(x, *cond_inputs, training=training)
         dx = self._scale_up(dx)
         dx = conv_cls(
-            out_channels=self.out_channels, stride=self.stride, order=order1, name="conv_1", **common
+            out_channels=self.out_channels, stride=self.stride, order=order1,
+            bias=bias_1, name="conv_1", **common
         )(dx, *cond_inputs, training=training)
         dx = self._scale_down(dx)
 
@@ -120,7 +125,8 @@ class _BaseResBlock(nn.Module):
                 sc_common["activation_norm_type"] = ""
             sc_common["nonlinearity"] = ""
             xs = conv_cls(
-                out_channels=self.out_channels, stride=self.stride, order="CN", name="conv_s", **sc_common
+                out_channels=self.out_channels, stride=self.stride, order="CN",
+                bias=bias_s, name="conv_s", **sc_common
             )(xs, *cond_inputs, training=training)
         xs = self._scale_down(xs)
         return xs + dx
@@ -220,10 +226,15 @@ class _HyperConvNorm(nn.Module):
     def __call__(self, x, *cond_inputs, conv_weights=None, norm_weights=None, training=False):
         from imaginaire_tpu.layers import hyper_ops
         from imaginaire_tpu.layers.activation_norm import get_activation_norm_layer
-        from imaginaire_tpu.layers.nonlinearity import apply_nonlinearity
+        from imaginaire_tpu.layers.nonlinearity import apply_nonlinearity, needs_prelu_param
 
         norm = get_activation_norm_layer(
             self.activation_norm_type, self.activation_norm_params, name="norm"
+        )
+        prelu_alpha = (
+            self.param("prelu_alpha", nn.initializers.constant(0.25), ())
+            if needs_prelu_param(self.nonlinearity)
+            else None
         )
         for op in self.order:
             if op == "C":
@@ -245,7 +256,7 @@ class _HyperConvNorm(nn.Module):
                     else:
                         x = norm(x, *cond_inputs, training=training)
             elif op == "A":
-                x = apply_nonlinearity(x, self.nonlinearity, None)
+                x = apply_nonlinearity(x, self.nonlinearity, prelu_alpha)
         return x
 
 

@@ -58,7 +58,8 @@ def spectral_normalize(module, kernel, training, name="u", n_steps=1, eps=1e-12)
         ),
     )
     sigma, new_u = power_iteration(w_mat, u_var.value, n_steps=n_steps, eps=eps)
-    if training and not module.is_initializing():
+    if (training and not module.is_initializing()
+            and module.is_mutable_collection("spectral")):
         u_var.value = new_u
     return kernel / sigma
 

@@ -1,0 +1,23 @@
+"""Loss library (ref: imaginaire/losses/).
+
+TPU-first design: losses are pure functions over pytrees (no nn.Module
+state), so they inline into the jitted train step and fuse with the
+surrounding graph. Multi-scale discriminator outputs arrive as lists of
+arrays; feature-matching inputs as list-of-list pytrees — both are
+Python-level structures, static under jit.
+"""
+
+from imaginaire_tpu.losses.gan import gan_loss
+from imaginaire_tpu.losses.feature_matching import feature_matching_loss
+from imaginaire_tpu.losses.kl import gaussian_kl_loss
+from imaginaire_tpu.losses.perceptual import PerceptualLoss
+from imaginaire_tpu.losses.flow import masked_l1_loss, FlowLoss
+
+__all__ = [
+    "gan_loss",
+    "feature_matching_loss",
+    "gaussian_kl_loss",
+    "PerceptualLoss",
+    "masked_l1_loss",
+    "FlowLoss",
+]

@@ -1,0 +1,30 @@
+"""Feature-matching loss (ref: imaginaire/losses/feature_matching.py:8-38).
+
+L1 (or L2) between discriminator features of fake vs real images, summed
+over layers, weighted 1/num_discriminators. The real-branch stop_gradient
+mirrors the reference's ``.detach()`` so D features of real images don't
+receive generator gradients.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def feature_matching_loss(fake_features, real_features, criterion="l1"):
+    """fake_features / real_features: list (per D) of lists (per layer)."""
+    num_d = len(fake_features)
+    dis_weight = 1.0 / num_d
+    loss = jnp.zeros(())
+    for fake_per_d, real_per_d in zip(fake_features, real_features):
+        for fake_f, real_f in zip(fake_per_d, real_per_d):
+            real_f = jax.lax.stop_gradient(real_f)
+            if criterion == "l1":
+                term = jnp.mean(jnp.abs(fake_f - real_f))
+            elif criterion in ("l2", "mse"):
+                term = jnp.mean((fake_f - real_f) ** 2)
+            else:
+                raise ValueError(f"Criterion {criterion} is not recognized")
+            loss = loss + dis_weight * term
+    return loss

@@ -1,0 +1,60 @@
+"""GAN objectives (ref: imaginaire/losses/gan.py:30-132).
+
+Four modes — hinge / least_square / non_saturated / wasserstein — with the
+reference's list-input convention: a multi-scale discriminator passes a
+list of per-scale outputs and the loss is averaged per scale first, then
+across scales, so high-resolution scales don't dominate the gradient
+(ref: gan.py:61-72).
+
+Written as a pure function: ``dis_update`` / ``t_real`` are Python bools
+(static under jit), so each variant traces to a minimal fused graph — the
+reference needed ``torch.jit.script`` fusion for the hinge terms
+(ref: gan.py:12-27); XLA fuses these for free.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _single_gan_loss(logits, t_real, mode, dis_update, real_label, fake_label):
+    if not dis_update and not t_real:
+        raise ValueError("The target should be real when updating the generator.")
+    if mode == "non_saturated":
+        target = jnp.full_like(logits, real_label if t_real else fake_label)
+        # BCE-with-logits, mean reduction (ref: gan.py:92-95).
+        loss = jnp.maximum(logits, 0) - logits * target + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.mean(loss)
+    if mode == "least_square":
+        target = jnp.full_like(logits, real_label if t_real else fake_label)
+        return 0.5 * jnp.mean((logits - target) ** 2)
+    if mode == "hinge":
+        if dis_update:
+            if t_real:
+                return -jnp.mean(jnp.minimum(logits - 1.0, 0.0))
+            return -jnp.mean(jnp.minimum(-logits - 1.0, 0.0))
+        return -jnp.mean(logits)
+    if mode == "wasserstein":
+        return -jnp.mean(logits) if t_real else jnp.mean(logits)
+    raise ValueError(f"Unexpected gan_mode {mode!r}")
+
+
+def gan_loss(dis_output, t_real, gan_mode="hinge", dis_update=True,
+             target_real_label=1.0, target_fake_label=0.0):
+    """GAN loss over a single logits array or a list of per-scale arrays.
+
+    Args:
+        dis_output: logits array, or list of logits arrays (multi-scale).
+        t_real: target is the real label (static Python bool).
+        gan_mode: 'hinge' | 'least_square' | 'non_saturated' | 'wasserstein'.
+        dis_update: True → discriminator form, False → generator form.
+    """
+    if isinstance(dis_output, (list, tuple)):
+        per_scale = [
+            _single_gan_loss(o, t_real, gan_mode, dis_update,
+                             target_real_label, target_fake_label)
+            for o in dis_output
+        ]
+        return sum(per_scale) / len(per_scale)
+    return _single_gan_loss(dis_output, t_real, gan_mode, dis_update,
+                            target_real_label, target_fake_label)

@@ -1,0 +1,15 @@
+"""Gaussian KL divergence for VAE-style encoders (ref: imaginaire/losses/kl.py:9-23).
+
+KL(N(mu, e^logvar) || N(0, 1)) = -0.5 * sum(1 + logvar - mu^2 - e^logvar).
+Sum reduction, matching the reference.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def gaussian_kl_loss(mu, logvar=None):
+    if logvar is None:
+        logvar = jnp.zeros_like(mu)
+    return -0.5 * jnp.sum(1.0 + logvar - mu ** 2 - jnp.exp(logvar))

@@ -1,0 +1,83 @@
+"""Small tensor utilities (ref: imaginaire/utils/misc.py).
+
+NHWC throughout. The reference's to_cuda/to_half family is replaced by
+dtype casts + device placement handled by jit; what remains useful on TPU
+is imagenet normalization, label splitting, and resize wrappers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# torchvision ImageNet statistics (ref: utils/misc.py apply_imagenet_normalization).
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def apply_imagenet_normalization(x):
+    """Map [-1, 1] images to imagenet-normalized (ref: utils/misc.py:~200).
+
+    Args:
+        x: (..., H, W, C>=3) in [-1, 1]. Only the first 3 channels are kept
+           (the fork's 4-channel RGBA hack, ref: losses/perceptual.py:97).
+    """
+    x = x[..., :3]
+    x = (x + 1.0) * 0.5
+    mean = jnp.asarray(IMAGENET_MEAN, dtype=x.dtype)
+    std = jnp.asarray(IMAGENET_STD, dtype=x.dtype)
+    return (x - mean) / std
+
+
+def resize_bilinear(x, hw):
+    """Bilinear resize of NHWC batch to (H, W)."""
+    n, _, _, c = x.shape
+    return jax.image.resize(x, (n, hw[0], hw[1], c), method="bilinear")
+
+
+def resize_nearest(x, hw):
+    n, _, _, c = x.shape
+    return jax.image.resize(x, (n, hw[0], hw[1], c), method="nearest")
+
+
+def downsample_2x(x, method="bilinear"):
+    n, h, w, c = x.shape
+    return jax.image.resize(x, (n, h // 2, w // 2, c), method=method)
+
+
+def split_labels(labels, label_lengths):
+    """Split a concatenated one-hot label tensor back into named parts
+    (ref: utils/misc.py:17-41). labels: (..., C) channel-last."""
+    out = {}
+    start = 0
+    for name, length in label_lengths.items():
+        out[name] = labels[..., start:start + length]
+        start += length
+    return out
+
+
+def to_float(tree):
+    return jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), tree)
+
+
+def to_bf16(tree):
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16) if jnp.issubdtype(a.dtype, jnp.floating) else a,
+        tree,
+    )
+
+
+def gradient_penalty(d_apply, params, images, key):
+    """R1-style gradient penalty helper used by MUNIT's optional GP
+    (ref: trainers/munit.py gp loss): E[||∇_x D(x)||²]."""
+
+    def d_sum(x):
+        out = d_apply(params, x)
+        if isinstance(out, (list, tuple)):
+            out = sum(jnp.sum(o) for o in out)
+        else:
+            out = jnp.sum(out)
+        return out
+
+    grads = jax.grad(d_sum)(images)
+    return jnp.mean(jnp.sum(grads ** 2, axis=tuple(range(1, grads.ndim))))
