@@ -1,0 +1,124 @@
+"""Benchmark: SPADE training throughput on the real TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures steady-state imgs/sec of the full alternating D+G SPADE training
+step (both updates per batch, reference semantics) at 256x256 with the
+reference's COCO-Stuff channel budget (184 label channels, nf=64 G /
+nf=64 D — the reference unit-test width; the zoo config uses 128).
+
+vs_baseline derivation: the reference documents only "~2-3 weeks" for
+400 epochs of COCO-Stuff (~118,287 train images) on 8x V100
+(projects/spade/README.md:24-25, MODELZOO.md:10). Taking 17.5 days:
+400*118287 / (17.5*86400) / 8 = 3.91 imgs/sec per V100. vs_baseline is
+our imgs/sec/chip divided by that.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+V100_IMGS_PER_SEC = 3.91
+
+
+def build():
+    import jax
+
+    from imaginaire_tpu.config import Config
+    from imaginaire_tpu.registry import resolve
+
+    cfg = Config()
+    cfg.trainer.type = "imaginaire_tpu.trainers.spade"
+    cfg.trainer.gan_mode = "hinge"
+    cfg.trainer.loss_weight = {"gan": 1.0, "feature_matching": 10.0,
+                               "kl": 0.05, "perceptual": 10.0}
+    cfg.trainer.perceptual_loss = {
+        "mode": "vgg19",
+        "layers": ["relu_1_1", "relu_2_1", "relu_3_1", "relu_4_1", "relu_5_1"],
+        "weights": [0.03125, 0.0625, 0.125, 0.25, 1.0]}
+    cfg.trainer.model_average = True
+    cfg.gen = {
+        "type": "imaginaire_tpu.models.generators.spade",
+        "style_dims": 256, "num_filters": 64, "kernel_size": 3,
+        "weight_norm_type": "spectral",
+        "global_adaptive_norm_type": "instance",
+        "activation_norm_params": {"num_filters": 128, "kernel_size": 3,
+                                   "activation_norm_type": "instance",
+                                   "weight_norm_type": "none",
+                                   "separate_projection": False},
+        "style_enc": {"num_filters": 64, "kernel_size": 3},
+    }
+    cfg.dis = {
+        "type": "imaginaire_tpu.models.discriminators.spade",
+        "num_filters": 64, "max_num_filters": 512, "num_discriminators": 2,
+        "num_layers": 5, "weight_norm_type": "spectral",
+    }
+    n_seg = 183
+    cfg.data = {
+        "name": "bench", "type": "imaginaire_tpu.data.paired_images",
+        "input_types": [
+            {"images": {"num_channels": 3, "normalize": True}},
+            {"seg_maps": {"num_channels": n_seg, "is_mask": True,
+                          "use_dont_care": True, "interpolator": "NEAREST"}},
+        ],
+        "input_image": ["images"],
+        "input_labels": ["seg_maps"],
+        "train": {"batch_size": 1,
+                  "augmentations": {"random_crop_h_w": "256, 256"}},
+    }
+    cfg.gen_opt.lr = 1e-4
+    cfg.dis_opt.lr = 4e-4
+    trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+    return trainer, n_seg + 1
+
+
+def batch_of(bs, label_ch):
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, label_ch, (bs, 256, 256))
+    label = np.eye(label_ch, dtype=np.float32)[idx]
+    return {
+        "images": rng.rand(bs, 256, 256, 3).astype(np.float32) * 2 - 1,
+        "label": label,
+    }
+
+
+def main():
+    import jax
+
+    trainer, label_ch = build()
+    last_error = None
+    for bs in (16, 8, 4, 2, 1):
+        try:
+            data = jax.tree_util.tree_map(np.asarray, batch_of(bs, label_ch))
+            trainer.init_state(jax.random.PRNGKey(0), data)
+            # warmup: compile both steps + 1 extra for stabilization
+            for _ in range(2):
+                trainer.dis_update(data)
+                trainer.gen_update(data)
+            jax.block_until_ready(trainer.state["vars_G"]["params"])
+            iters = 10
+            t0 = time.time()
+            for _ in range(iters):
+                trainer.dis_update(data)
+                trainer.gen_update(data)
+            jax.block_until_ready(trainer.state["vars_G"]["params"])
+            dt = time.time() - t0
+            imgs_per_sec = bs * iters / dt
+            print(json.dumps({
+                "metric": "spade_256_train_imgs_per_sec_per_chip",
+                "value": round(imgs_per_sec, 3),
+                "unit": "imgs/sec/chip",
+                "vs_baseline": round(imgs_per_sec / V100_IMGS_PER_SEC, 3),
+            }))
+            return
+        except Exception as e:  # OOM etc. -> halve batch
+            last_error = e
+            continue
+    raise SystemExit(f"bench failed at all batch sizes: {last_error}")
+
+
+if __name__ == "__main__":
+    main()

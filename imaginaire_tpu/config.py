@@ -72,6 +72,18 @@ def _wrap(value):
     return value
 
 
+def as_attrdict(obj):
+    """Recursively convert any Mapping (incl. flax FrozenDict — linen
+    converts dict module fields to FrozenDict) back to AttrDict."""
+    from collections.abc import Mapping
+
+    if isinstance(obj, Mapping):
+        return AttrDict({k: as_attrdict(v) for k, v in obj.items()})
+    if isinstance(obj, (list, tuple)):
+        return [as_attrdict(v) for v in obj]
+    return obj
+
+
 def recursive_update(base, overlay):
     """Recursively overlay ``overlay`` onto AttrDict ``base`` in place.
 
@@ -224,6 +236,10 @@ class Config(AttrDict):
 
 
 def cfg_get(cfg, key, default=None):
+    from collections.abc import Mapping
+
+    if isinstance(cfg, Mapping) and not isinstance(cfg, AttrDict):
+        return cfg.get(key, default)
     """`getattr(cfg, key, default)` idiom used pervasively by the reference
     (ref: generators/spade.py:40-42)."""
     try:

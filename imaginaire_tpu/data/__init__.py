@@ -1,0 +1,14 @@
+"""Data subsystem (ref: imaginaire/datasets/, utils/lmdb.py, utils/data.py).
+
+Host-side numpy pipeline feeding NHWC batches to the jitted train step.
+Per-host sharding replaces DistributedSampler (SURVEY.md §2.2): each JAX
+process reads its own slice of the global batch; inside jit the batch is
+already sharded over the 'data' mesh axis.
+"""
+
+from imaginaire_tpu.data.loader import (
+    get_test_dataloader,
+    get_train_and_val_dataloader,
+)
+
+__all__ = ["get_train_and_val_dataloader", "get_test_dataloader"]

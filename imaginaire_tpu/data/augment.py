@@ -1,0 +1,119 @@
+"""Config-driven augmentation pipeline
+(ref: imaginaire/utils/data.py:26-250 Augmentor on albumentations).
+
+cv2-based reimplementation of the reference's augmentation keys, applied
+jointly to all augmentable data types (paired mode). Label-like types
+(NEAREST interpolator) are resized with nearest-neighbor; images with the
+configured interpolator. Augmentations are ordered as given in the
+config, matching the reference's ``_build_augmentation_ops``.
+
+Supported keys: resize_smallest_side, resize_h_w, random_resize_h_w_aspect,
+rotate, random_rotate_90, random_scale_limit, random_crop_h_w,
+center_crop_h_w, horizontal_flip, max_time_step.
+"""
+
+from __future__ import annotations
+
+import random
+
+import cv2
+import numpy as np
+
+_INTERP = {
+    "NEAREST": cv2.INTER_NEAREST,
+    "BILINEAR": cv2.INTER_LINEAR,
+    "BICUBIC": cv2.INTER_CUBIC,
+    None: cv2.INTER_LINEAR,
+}
+
+
+def _parse_hw(value):
+    h, w = str(value).split(",")
+    return int(h), int(w)
+
+
+class Augmentor:
+    def __init__(self, aug_cfg, interpolators=None):
+        self.cfg = dict(aug_cfg or {})
+        self.interpolators = interpolators or {}
+        self.max_time_step = int(self.cfg.get("max_time_step", 1))
+        self.original_h = 0
+        self.original_w = 0
+
+    def _interp(self, data_type):
+        return _INTERP.get(self.interpolators.get(data_type), cv2.INTER_LINEAR)
+
+    def perform_augmentation(self, inputs, paired=True):
+        """inputs: {data_type: [HWC np.ndarray, ...]}. Returns (outputs,
+        is_flipped). Same random draw applied across types and frames."""
+        first = next(iter(inputs.values()))[0]
+        self.original_h, self.original_w = first.shape[:2]
+        h, w = first.shape[:2]
+
+        ops = []
+        cfg = self.cfg
+        if "resize_smallest_side" in cfg:
+            s = int(cfg["resize_smallest_side"])
+            scale = s / min(h, w)
+            h, w = int(round(h * scale)), int(round(w * scale))
+            ops.append(("resize", (h, w)))
+        if "resize_h_w" in cfg:
+            h, w = _parse_hw(cfg["resize_h_w"])
+            ops.append(("resize", (h, w)))
+        if "random_resize_h_w_aspect" in cfg:
+            # 'H,W' base with aspect jitter from random_scale_limit.
+            bh, bw = _parse_hw(cfg["random_resize_h_w_aspect"])
+            limit = float(cfg.get("random_scale_limit", 0.2))
+            aspect = 1.0 + random.uniform(0, limit)
+            h, w = int(round(bh * aspect)), int(round(bw * aspect))
+            ops.append(("resize", (h, w)))
+        elif "random_scale_limit" in cfg and "resize_smallest_side" in cfg:
+            limit = float(cfg["random_scale_limit"])
+            scale = 1.0 + random.uniform(0, limit)
+            h, w = int(round(h * scale)), int(round(w * scale))
+            ops.append(("resize", (h, w)))
+        rotate = float(cfg.get("rotate", 0) or 0)
+        if rotate:
+            ops.append(("rotate", random.uniform(-rotate, rotate)))
+        if cfg.get("random_rotate_90", False):
+            ops.append(("rot90", random.randint(0, 3)))
+        crop = None
+        if "random_crop_h_w" in cfg:
+            ch, cw = _parse_hw(cfg["random_crop_h_w"])
+            top = random.randint(0, max(h - ch, 0))
+            left = random.randint(0, max(w - cw, 0))
+            crop = (top, left, ch, cw)
+        elif "center_crop_h_w" in cfg:
+            ch, cw = _parse_hw(cfg["center_crop_h_w"])
+            crop = (max(h - ch, 0) // 2, max(w - cw, 0) // 2, ch, cw)
+        if crop:
+            ops.append(("crop", crop))
+        is_flipped = bool(cfg.get("horizontal_flip", False)) and random.random() < 0.5
+        if is_flipped:
+            ops.append(("hflip", None))
+
+        out = {}
+        for data_type, frames in inputs.items():
+            interp = self._interp(data_type)
+            out[data_type] = [self._apply(f, ops, interp) for f in frames]
+        return out, is_flipped
+
+    @staticmethod
+    def _apply(img, ops, interp):
+        for op, arg in ops:
+            if op == "resize":
+                img = cv2.resize(img, (arg[1], arg[0]), interpolation=interp)
+            elif op == "rotate":
+                hh, ww = img.shape[:2]
+                m = cv2.getRotationMatrix2D((ww / 2, hh / 2), arg, 1.0)
+                img = cv2.warpAffine(img, m, (ww, hh), flags=interp)
+            elif op == "rot90":
+                img = np.rot90(img, arg)
+            elif op == "crop":
+                top, left, ch, cw = arg
+                img = img[top:top + ch, left:left + cw]
+            elif op == "hflip":
+                img = img[:, ::-1]
+            if img.ndim == 2:
+                img = img[:, :, None]
+        return np.ascontiguousarray(img)

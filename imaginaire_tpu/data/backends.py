@@ -1,0 +1,154 @@
+"""Storage backends (ref: imaginaire/datasets/{lmdb,folder}.py,
+imaginaire/utils/lmdb.py).
+
+Three backends with one interface — ``getitem(key) -> np.ndarray (HWC)``:
+
+  FolderBackend  : raw files under ``root/<data_type>/<sequence>/<file>.<ext>``
+                   (ref: datasets/folder.py:15-86).
+  LMDBBackend    : readonly LMDB, cv2.imdecode, BGR->RGB
+                   (ref: datasets/lmdb.py:17-79) — gated on the ``lmdb``
+                   package being installed.
+  PackedBackend  : TPU-native equivalent of the LMDB shard: one
+                   ``.bin`` blob + ``.idx.json`` offsets per data type,
+                   written by ``build_packed_dataset``. Same role (large
+                   sequential reads off network storage feeding TPU-VM
+                   hosts) with zero external dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import cv2
+import numpy as np
+
+
+def _decode_image(buf, ext):
+    if ext in ("npy",):
+        from io import BytesIO
+
+        return np.load(BytesIO(buf))
+    arr = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), cv2.IMREAD_UNCHANGED)
+    if arr is None:
+        raise ValueError("failed to decode image buffer")
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    elif arr.shape[2] == 3:
+        arr = cv2.cvtColor(arr, cv2.COLOR_BGR2RGB)
+    elif arr.shape[2] == 4:
+        arr = cv2.cvtColor(arr, cv2.COLOR_BGRA2RGBA)
+    return arr
+
+
+class FolderBackend:
+    """(ref: datasets/folder.py:15-86)."""
+
+    def __init__(self, root, ext=None):
+        self.root = root
+        self.ext = ext
+
+    def getitem(self, key):
+        path = os.path.join(self.root, key)
+        if self.ext:
+            path = f"{path}.{self.ext}"
+        if path.endswith(".npy"):
+            return np.load(path)
+        with open(path, "rb") as f:
+            buf = f.read()
+        return _decode_image(buf, path.rsplit(".", 1)[-1])
+
+
+class LMDBBackend:
+    """(ref: datasets/lmdb.py:17-79). Requires the ``lmdb`` package."""
+
+    def __init__(self, root, ext=None):
+        try:
+            import lmdb
+        except ImportError as e:
+            raise ImportError(
+                "The 'lmdb' package is not installed in this environment; "
+                "use the folder backend (is_lmdb: False) or PackedBackend "
+                "(is_packed: True) instead.") from e
+        self.env = lmdb.open(root, readonly=True, lock=False, readahead=False,
+                             meminit=False)
+        meta = os.path.join(root, "metadata.json")
+        self.ext = ext
+        if os.path.exists(meta):
+            with open(meta) as f:
+                self.ext = json.load(f).get("ext", ext)
+
+    def getitem(self, key):
+        with self.env.begin(write=False) as txn:
+            buf = txn.get(key.encode())
+        if buf is None:
+            raise KeyError(key)
+        return _decode_image(buf, self.ext)
+
+
+class PackedBackend:
+    """Packed binary shard: ``data.bin`` + ``index.json`` ({key: [off, len,
+    ext]}). Reads are a single seek+read — the property LMDB provided."""
+
+    def __init__(self, root, ext=None):
+        with open(os.path.join(root, "index.json")) as f:
+            self.index = json.load(f)
+        self.bin_path = os.path.join(root, "data.bin")
+        self._f = None
+        self.ext = ext
+
+    def getitem(self, key):
+        if self._f is None:  # lazy per-worker open
+            self._f = open(self.bin_path, "rb")
+        off, length, ext = self.index[key]
+        self._f.seek(off)
+        buf = self._f.read(length)
+        return _decode_image(buf, ext or self.ext)
+
+
+def build_packed_dataset(data_root, out_root, data_types):
+    """Pack ``data_root/<data_type>/<sequence>/<file>`` trees into one
+    blob per data type + all_filenames.json (the builder contract of
+    ref: utils/lmdb.py:56-129 / scripts/build_lmdb.py:40-125)."""
+    os.makedirs(out_root, exist_ok=True)
+    sequence_files = {}
+    for data_type in data_types:
+        type_root = os.path.join(data_root, data_type)
+        type_out = os.path.join(out_root, data_type)
+        os.makedirs(type_out, exist_ok=True)
+        index = {}
+        with open(os.path.join(type_out, "data.bin"), "wb") as out:
+            for seq in sorted(os.listdir(type_root)):
+                seq_dir = os.path.join(type_root, seq)
+                if not os.path.isdir(seq_dir):
+                    continue
+                for fname in sorted(os.listdir(seq_dir)):
+                    stem, ext = os.path.splitext(fname)
+                    key = f"{seq}/{stem}"
+                    with open(os.path.join(seq_dir, fname), "rb") as f:
+                        buf = f.read()
+                    index[key] = [out.tell(), len(buf), ext.lstrip(".")]
+                    out.write(buf)
+                    sequence_files.setdefault(seq, [])
+                    if stem not in sequence_files[seq]:
+                        sequence_files[seq].append(stem)
+        with open(os.path.join(type_out, "index.json"), "w") as f:
+            json.dump(index, f)
+    with open(os.path.join(out_root, "all_filenames.json"), "w") as f:
+        json.dump(sequence_files, f)
+    return out_root
+
+
+def create_folder_metadata(data_root, data_types):
+    """Walk a raw folder tree -> {sequence: [stems]} (runtime version of
+    the builder's metadata, ref: utils/lmdb.py:132-215)."""
+    first_type = data_types[0]
+    type_root = os.path.join(data_root, first_type)
+    sequences = {}
+    for seq in sorted(os.listdir(type_root)):
+        seq_dir = os.path.join(type_root, seq)
+        if not os.path.isdir(seq_dir):
+            continue
+        stems = [os.path.splitext(f)[0] for f in sorted(os.listdir(seq_dir))]
+        sequences[seq] = stems
+    return sequences

@@ -1,0 +1,203 @@
+"""Config-driven multi-type dataset base (ref: imaginaire/datasets/base.py).
+
+Per data type the config declares ext / num_channels / normalize /
+interpolator / use_dont_care / is_mask / pre+post aug ops
+(ref: base.py:92-150). Items come out as channel-last float32 numpy with:
+  - images normalized to [-1, 1] when ``normalize`` (ref: base.py:203-237),
+  - 1-channel label maps one-hot expanded to num_channels (+1 dont-care
+    channel kept when use_dont_care, ref: base.py:272-298),
+  - all ``input_labels`` types concatenated into ``data['label']``
+    (ref: paired_videos.py:276-283).
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+
+import numpy as np
+
+from imaginaire_tpu.config import as_attrdict, cfg_get
+from imaginaire_tpu.data.augment import Augmentor
+from imaginaire_tpu.data.backends import (
+    FolderBackend,
+    LMDBBackend,
+    PackedBackend,
+    create_folder_metadata,
+)
+
+
+class BaseDataset:
+    def __init__(self, cfg, is_inference=False, is_test=False):
+        cfg = as_attrdict(cfg)
+        self.cfg = cfg
+        self.is_inference = is_inference
+        self.is_test = is_test
+        self.cfgdata = cfg.test_data if is_test else cfg.data
+        data_info = (self.cfgdata.test if is_test
+                     else (self.cfgdata.val if is_inference else self.cfgdata.train))
+        self.data_info = data_info
+        self.name = cfg_get(self.cfgdata, "name", "dataset")
+        self.roots = list(data_info.roots)
+        self.batch_size = cfg_get(data_info, "batch_size", 1)
+
+        backend = "folder"
+        if cfg_get(data_info, "is_lmdb", False):
+            backend = "lmdb"
+        elif cfg_get(data_info, "is_packed", False):
+            backend = "packed"
+        self.backend_kind = backend
+
+        # Per-type properties (ref: base.py:92-150).
+        self.data_types = []
+        self.image_data_types = []
+        self.extensions = {}
+        self.normalize = {}
+        self.interpolators = {}
+        self.num_channels = {}
+        self.use_dont_care = {}
+        self.is_mask = {}
+        self.pre_aug_ops = {}
+        self.post_aug_ops = {}
+        for data_type in self.cfgdata.input_types:
+            (name, info), = data_type.items()
+            self.data_types.append(name)
+            self.image_data_types.append(name)
+            self.extensions[name] = cfg_get(info, "ext", None)
+            self.normalize[name] = cfg_get(info, "normalize", False)
+            self.interpolators[name] = cfg_get(info, "interpolator", None)
+            self.num_channels[name] = cfg_get(info, "num_channels", None)
+            self.use_dont_care[name] = cfg_get(info, "use_dont_care", False)
+            self.is_mask[name] = cfg_get(info, "is_mask", False)
+            self.pre_aug_ops[name] = _parse_ops(cfg_get(info, "pre_aug_ops", "None"))
+            self.post_aug_ops[name] = _parse_ops(cfg_get(info, "post_aug_ops", "None"))
+        self.input_labels = list(cfg_get(self.cfgdata, "input_labels", None) or [])
+        self.input_image = list(cfg_get(self.cfgdata, "input_image", None) or [])
+
+        # Backends + sequence lists per root.
+        self.backends = {t: [] for t in self.data_types}
+        self.sequence_lists = []
+        for root in self.roots:
+            if backend == "folder":
+                self.sequence_lists.append(
+                    create_folder_metadata(root, self.data_types))
+            else:
+                import json
+
+                with open(os.path.join(root, "all_filenames.json")) as f:
+                    self.sequence_lists.append(json.load(f))
+            for t in self.data_types:
+                path = os.path.join(root, t)
+                if backend == "folder":
+                    self.backends[t].append(FolderBackend(path, self.extensions[t]))
+                elif backend == "lmdb":
+                    self.backends[t].append(LMDBBackend(path, self.extensions[t]))
+                else:
+                    self.backends[t].append(PackedBackend(path, self.extensions[t]))
+
+        aug_cfg = cfg_get(data_info, "augmentations", None) or {}
+        self.augmentor = Augmentor(aug_cfg, self.interpolators)
+
+    # ------------------------------------------------------------------ api
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, index):
+        raise NotImplementedError
+
+    def get_label_lengths(self):
+        """{label type: channel count incl. dont-care} (ref: base.py:204-218)."""
+        lengths = {}
+        for t in self.input_labels:
+            n = self.num_channels[t]
+            if self.use_dont_care[t]:
+                n += 1
+            lengths[t] = n
+        return lengths
+
+    # ------------------------------------------------------------- loading
+
+    def load_item(self, lmdb_idx, sequence_name, filenames):
+        """Load all data types for the given frames -> {type: [HWC arrays]}."""
+        data = {}
+        for t in self.data_types:
+            frames = []
+            for fname in filenames:
+                key = f"{sequence_name}/{fname}"
+                frames.append(self.backends[t][lmdb_idx].getitem(key))
+            data[t] = frames
+        return data
+
+    def process_item(self, data):
+        """pre-ops -> joint augmentation -> post-ops -> normalize/one-hot ->
+        concat labels. Returns dict of (T,H,W,C) or (H,W,C) float arrays."""
+        data = self._apply_ops(data, self.pre_aug_ops)
+        data, is_flipped = self.augmentor.perform_augmentation(
+            data, paired=True)
+        data = self._apply_ops(data, self.post_aug_ops)
+
+        out = {}
+        for t in self.data_types:
+            frames = []
+            for arr in data[t]:
+                arr = arr.astype(np.float32)
+                if arr.dtype != np.float32:
+                    arr = arr.astype(np.float32)
+                if self.is_mask[t] or (self.num_channels[t] and
+                                       arr.shape[-1] == 1 and self.num_channels[t] > 1):
+                    arr = self._encode_onehot(
+                        arr, self.num_channels[t], self.use_dont_care[t])
+                else:
+                    if arr.max() > 1.5:  # uint8-range input
+                        arr = arr / 255.0
+                    if self.normalize[t]:
+                        arr = arr * 2.0 - 1.0
+                frames.append(arr)
+            out[t] = np.stack(frames, axis=0)
+        out["is_flipped"] = np.asarray(is_flipped)
+        return out
+
+    @staticmethod
+    def _encode_onehot(label_map, num_labels, use_dont_care):
+        """(H,W,1) index map -> (H,W,num_labels[+1]) one-hot
+        (ref: base.py:272-298): out-of-range and negative indices become
+        the dont-care index; channel kept only when use_dont_care."""
+        idx = label_map[..., 0].astype(np.int64)
+        idx[(idx < 0) | (idx >= num_labels)] = num_labels
+        out = np.zeros(idx.shape + (num_labels + 1,), dtype=np.float32)
+        np.put_along_axis(out, idx[..., None], 1.0, axis=-1)
+        if not use_dont_care:
+            out = out[..., :num_labels]
+        return out
+
+    def concat_labels(self, out, squeeze_time=False):
+        """(ref: paired_videos.py:276-283)."""
+        if self.input_labels:
+            labels = [out.pop(t) for t in self.input_labels]
+            out["label"] = np.concatenate(labels, axis=-1)
+        if squeeze_time:
+            for k in list(out.keys()):
+                v = out[k]
+                if isinstance(v, np.ndarray) and v.ndim >= 4:
+                    out[k] = v[0] if v.shape[0] == 1 else v
+        return out
+
+    def _apply_ops(self, data, op_dict):
+        """'module::function' plugin ops (ref: base.py:386-460)."""
+        for t, ops in op_dict.items():
+            for op in ops:
+                data[t] = op(data[t])
+        return data
+
+
+def _parse_ops(spec):
+    if not spec or spec == "None":
+        return []
+    ops = []
+    for item in str(spec).split(","):
+        item = item.strip()
+        if "::" in item:
+            module, fn = item.split("::")
+            ops.append(getattr(importlib.import_module(module), fn))
+    return ops

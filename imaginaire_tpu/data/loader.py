@@ -1,0 +1,88 @@
+"""Batching + per-host sharding loader
+(ref: imaginaire/utils/dataset.py:24-83).
+
+Replaces DataLoader + DistributedSampler: each JAX process takes the
+index slice ``process_index::process_count`` of the shuffled epoch
+(ref sharding: utils/dataset.py:46-50), batches on the host, and yields
+dicts of stacked NHWC arrays. ``set_epoch`` reseeds the shuffle like
+``DistributedSampler.set_epoch`` (ref: train.py:70).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from imaginaire_tpu.config import cfg_get
+from imaginaire_tpu.parallel.mesh import get_rank, get_world_size
+from imaginaire_tpu.registry import resolve
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size, shuffle=True, seed=0,
+                 drop_last=True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.drop_last = drop_last
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __len__(self):
+        n = len(self.dataset) // get_world_size()
+        if self.drop_last:
+            return max(n // self.batch_size, 1)
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            rng.shuffle(order)
+        order = order[get_rank()::get_world_size()]
+        batch = []
+        for idx in order:
+            batch.append(self.dataset[int(idx)])
+            if len(batch) == self.batch_size:
+                yield self._collate(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self._collate(batch)
+
+    @staticmethod
+    def _collate(items):
+        out = {}
+        for k in items[0]:
+            vals = [it[k] for it in items]
+            if isinstance(vals[0], np.ndarray) and vals[0].dtype != object:
+                out[k] = np.stack(vals, axis=0)
+            else:
+                out[k] = vals
+        return out
+
+
+def _build_dataset(cfg, is_inference=False, is_test=False):
+    """(ref: utils/dataset.py:24-43)."""
+    dataset_cls = resolve(cfg.test_data.type if is_test else cfg.data.type,
+                          "Dataset")
+    return dataset_cls(cfg, is_inference=is_inference, is_test=is_test)
+
+
+def get_train_and_val_dataloader(cfg, seed=0):
+    """(ref: utils/dataset.py:63-83)."""
+    train_ds = _build_dataset(cfg, is_inference=False)
+    val_ds = _build_dataset(cfg, is_inference=True)
+    train = DataLoader(train_ds, cfg_get(cfg.data.train, "batch_size", 1),
+                       shuffle=True, seed=seed)
+    val = DataLoader(val_ds, cfg_get(cfg.data.val, "batch_size", 1),
+                     shuffle=False, seed=seed)
+    return train, val
+
+
+def get_test_dataloader(cfg):
+    ds = _build_dataset(cfg, is_inference=True, is_test=True)
+    return DataLoader(ds, cfg_get(cfg.test_data.test, "batch_size", 1),
+                      shuffle=False, drop_last=False)

@@ -1,0 +1,32 @@
+"""Paired image dataset — SPADE / pix2pixHD
+(ref: imaginaire/datasets/paired_images.py:9-86, a seq_len=1
+specialization of paired_videos).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from imaginaire_tpu.data.base import BaseDataset
+
+
+class Dataset(BaseDataset):
+    def __init__(self, cfg, is_inference=False, is_test=False):
+        super().__init__(cfg, is_inference, is_test)
+        # Flatten (root, sequence, frame) into a global index.
+        self.items = []
+        for root_idx, seqs in enumerate(self.sequence_lists):
+            for seq, stems in seqs.items():
+                for stem in stems:
+                    self.items.append((root_idx, seq, stem))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, index):
+        root_idx, seq, stem = self.items[index % len(self.items)]
+        raw = self.load_item(root_idx, seq, [stem])
+        out = self.process_item(raw)
+        out = self.concat_labels(out, squeeze_time=True)
+        out["key"] = f"{seq}/{stem}"
+        return out

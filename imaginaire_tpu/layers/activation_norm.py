@@ -136,18 +136,26 @@ class AdaptiveNorm(nn.Module):
     base_norm: str = "instance"
     separate_projection: bool = False
     projection_bias: bool = True
+    weight_norm_type: str = ""
 
     @nn.compact
     def __call__(self, x, cond, training=False):
+        from imaginaire_tpu.layers.conv import LinearBlock
+
         c = x.shape[-1]
         norm = _base_norm(self.base_norm, affine=False)
         y = norm(x, training=training)
+
+        def dense(feats, name):
+            return LinearBlock(feats, bias=self.projection_bias, order="C",
+                               weight_norm_type=self.weight_norm_type, name=name)
+
         if self.projection == "linear":
             if self.separate_projection:
-                gamma = nn.Dense(c, use_bias=self.projection_bias, name="fc_gamma")(cond)
-                beta = nn.Dense(c, use_bias=self.projection_bias, name="fc_beta")(cond)
+                gamma = dense(c, "fc_gamma")(cond, training=training)
+                beta = dense(c, "fc_beta")(cond, training=training)
             else:
-                gb = nn.Dense(2 * c, use_bias=self.projection_bias, name="fc")(cond)
+                gb = dense(2 * c, "fc")(cond, training=training)
                 gamma, beta = jnp.split(gb, 2, axis=-1)
             # broadcast (B, C) over spatial dims
             shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (c,)
@@ -175,12 +183,20 @@ class SpatiallyAdaptiveNorm(nn.Module):
     separate_projection: bool = True
     partial: bool = False
     interpolation: str = "nearest"
+    weight_norm_type: str = ""
 
     @nn.compact
     def __call__(self, x, *cond_inputs, training=False):
+        from imaginaire_tpu.layers.conv import Conv2dBlock, PartialConv2d
+
         c = x.shape[-1]
         hw = x.shape[1:3]
         y = _base_norm(self.base_norm, affine=False)(x, training=training)
+
+        def conv(feats, name):
+            return Conv2dBlock(feats, kernel_size=self.kernel_size, order="C",
+                               weight_norm_type=self.weight_norm_type, name=name)
+
         gamma_sum = None
         beta_sum = None
         for i, cond in enumerate(cond_inputs):
@@ -193,34 +209,19 @@ class SpatiallyAdaptiveNorm(nn.Module):
             if mask is not None:
                 mask = _resize(mask, hw, self.interpolation)
             if self.partial and mask is not None:
-                from imaginaire_tpu.layers.conv import PartialConv2d
-
                 hidden, _ = PartialConv2d(
                     self.num_filters, self.kernel_size, name=f"mlp_{i}"
                 )(cond, mask)
                 hidden = nn.relu(hidden)
             elif self.num_filters > 0:
-                hidden = nn.relu(
-                    nn.Conv(
-                        self.num_filters,
-                        (self.kernel_size, self.kernel_size),
-                        padding="SAME",
-                        name=f"mlp_{i}",
-                    )(cond)
-                )
+                hidden = nn.relu(conv(self.num_filters, f"mlp_{i}")(cond, training=training))
             else:
                 hidden = cond
             if self.separate_projection:
-                gamma = nn.Conv(
-                    c, (self.kernel_size, self.kernel_size), padding="SAME", name=f"gamma_{i}"
-                )(hidden)
-                beta = nn.Conv(
-                    c, (self.kernel_size, self.kernel_size), padding="SAME", name=f"beta_{i}"
-                )(hidden)
+                gamma = conv(c, f"gamma_{i}")(hidden, training=training)
+                beta = conv(c, f"beta_{i}")(hidden, training=training)
             else:
-                gb = nn.Conv(
-                    2 * c, (self.kernel_size, self.kernel_size), padding="SAME", name=f"gb_{i}"
-                )(hidden)
+                gb = conv(2 * c, f"gb_{i}")(hidden, training=training)
                 gamma, beta = jnp.split(gb, 2, axis=-1)
             gamma_sum = gamma if gamma_sum is None else gamma_sum + gamma
             beta_sum = beta if beta_sum is None else beta_sum + beta
@@ -300,6 +301,10 @@ def get_activation_norm_layer(norm_type, norm_params=None, name=None):
     module with the uniform ``(x, *cond, training=)`` signature, or None."""
     p: dict[str, Any] = dict(norm_params or {})
     kw = {"name": name} if name else {}
+    # Accept the reference's '<x>_norm' spellings (e.g. mlp_multiclass
+    # passes 'batch_norm', ref: discriminators/mlp_multiclass.py:28-30).
+    if isinstance(norm_type, str) and norm_type.endswith("_norm"):
+        norm_type = norm_type[: -len("_norm")]
     if norm_type in ("", "none", None):
         return None
     if norm_type in ("batch", "sync_batch"):
@@ -317,6 +322,7 @@ def get_activation_norm_layer(norm_type, norm_params=None, name=None):
             projection=p.get("projection", "linear"),
             base_norm=p.get("activation_norm_type", "instance"),
             separate_projection=p.get("separate_projection", False),
+            weight_norm_type=p.get("weight_norm_type", ""),
             **kw,
         )
     if norm_type == "spatially_adaptive":
@@ -327,6 +333,7 @@ def get_activation_norm_layer(norm_type, norm_params=None, name=None):
             separate_projection=p.get("separate_projection", True),
             partial=p.get("partial", False),
             interpolation=p.get("interpolation", "nearest"),
+            weight_norm_type=p.get("weight_norm_type", ""),
             **kw,
         )
     if norm_type == "hyper_spatially_adaptive":

@@ -1,0 +1,1 @@
+"""Model zoo: generators and discriminators."""

@@ -1,0 +1,1 @@
+"""Discriminators (ref: imaginaire/discriminators/)."""

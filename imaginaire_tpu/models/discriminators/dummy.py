@@ -1,0 +1,16 @@
+"""No-op discriminator (ref: imaginaire/discriminators/dummy.py:10-29)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from flax import linen as nn
+
+
+class Discriminator(nn.Module):
+    dis_cfg: Any = None
+    data_cfg: Any = None
+
+    @nn.compact
+    def __call__(self, data, net_G_output, training=False):
+        return {}

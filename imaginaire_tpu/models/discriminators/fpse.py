@@ -1,0 +1,97 @@
+"""Feature-Pyramid Semantics-Embedding discriminator
+(ref: imaginaire/discriminators/fpse.py:15-133; OASIS-style FPN from
+arXiv:1910.06809).
+
+Bottom-up stride-2 encoder, top-down FPN with lateral 1x1 convs, and at
+three pyramid scales: a patch true/false logit plus a label-embedding
+dot-product alignment score added onto it. The embedding dot-product is
+a channel contraction — on TPU it lowers to an MXU matmul fused with the
+additions around it.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from imaginaire_tpu.layers import Conv2dBlock
+
+
+def _upsample2x_bilinear(x):
+    n, h, w, c = x.shape
+    return jax.image.resize(x, (n, 2 * h, 2 * w, c), method="bilinear")
+
+
+def _avg_pool2x(x):
+    return nn.avg_pool(x, (2, 2), strides=(2, 2))
+
+
+class FPSEDiscriminator(nn.Module):
+    num_labels: int
+    num_filters: int = 128
+    kernel_size: int = 3
+    weight_norm_type: str = "spectral"
+    activation_norm_type: str = "none"
+
+    @nn.compact
+    def __call__(self, images, segmaps, training=False):
+        nf = self.num_filters
+        ks = self.kernel_size
+        pad = int(math.ceil((ks - 1.0) / 2))
+
+        def down(ch, name):
+            return Conv2dBlock(ch, kernel_size=ks, stride=2, padding=pad,
+                               weight_norm_type=self.weight_norm_type,
+                               activation_norm_type=self.activation_norm_type,
+                               nonlinearity="leakyrelu", order="CNA", name=name)
+
+        def lat(ch, name):
+            return Conv2dBlock(ch, kernel_size=1, stride=1,
+                               weight_norm_type=self.weight_norm_type,
+                               activation_norm_type=self.activation_norm_type,
+                               nonlinearity="leakyrelu", order="CNA", name=name)
+
+        def final(ch, name):
+            return Conv2dBlock(ch, kernel_size=ks, stride=1, padding=pad,
+                               weight_norm_type=self.weight_norm_type,
+                               activation_norm_type=self.activation_norm_type,
+                               nonlinearity="leakyrelu", order="CNA", name=name)
+
+        # bottom-up pathway (ref: fpse.py:61-66)
+        feat11 = down(nf, "enc1")(images, training=training)
+        feat12 = down(2 * nf, "enc2")(feat11, training=training)
+        feat13 = down(4 * nf, "enc3")(feat12, training=training)
+        feat14 = down(8 * nf, "enc4")(feat13, training=training)
+        feat15 = down(8 * nf, "enc5")(feat14, training=training)
+        # top-down pathway + laterals (ref: fpse.py:101-105)
+        feat25 = lat(4 * nf, "lat5")(feat15, training=training)
+        feat24 = _upsample2x_bilinear(feat25) + lat(4 * nf, "lat4")(feat14, training=training)
+        feat23 = _upsample2x_bilinear(feat24) + lat(4 * nf, "lat3")(feat13, training=training)
+        feat22 = _upsample2x_bilinear(feat23) + lat(4 * nf, "lat2")(feat12, training=training)
+        # final layers (ref: fpse.py:107-109)
+        feat32 = final(2 * nf, "final2")(feat22, training=training)
+        feat33 = final(2 * nf, "final3")(feat23, training=training)
+        feat34 = final(2 * nf, "final4")(feat24, training=training)
+        # shared heads (ref: fpse.py:84-86)
+        output = Conv2dBlock(1, kernel_size=1, name="output")
+        seg_head = Conv2dBlock(2 * nf, kernel_size=1, name="seg")
+        pred2 = output(feat32, training=training)
+        pred3 = output(feat33, training=training)
+        pred4 = output(feat34, training=training)
+        seg2 = seg_head(feat32, training=training)
+        seg3 = seg_head(feat33, training=training)
+        seg4 = seg_head(feat34, training=training)
+        # label-embedding alignment scores (ref: fpse.py:117-131)
+        segembs = Conv2dBlock(2 * nf, kernel_size=1, name="embedding")(
+            segmaps, training=training)
+        segembs = _avg_pool2x(segembs)
+        segembs2 = _avg_pool2x(segembs)
+        segembs3 = _avg_pool2x(segembs2)
+        segembs4 = _avg_pool2x(segembs3)
+        pred2 += jnp.sum(segembs2 * seg2, axis=-1, keepdims=True)
+        pred3 += jnp.sum(segembs3 * seg3, axis=-1, keepdims=True)
+        pred4 += jnp.sum(segembs4 * seg4, axis=-1, keepdims=True)
+        return pred2, pred3, pred4

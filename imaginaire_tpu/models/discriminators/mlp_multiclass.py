@@ -1,0 +1,46 @@
+"""MLP multi-class classifier discriminator
+(ref: imaginaire/discriminators/mlp_multiclass.py:13-110; pose data).
+
+Dropout schedule matches the reference: 0.1 growing 1.5x per layer,
+capped at 0.5. Dropout draws from the 'dropout' RNG stream when training.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+from flax import linen as nn
+
+from imaginaire_tpu.config import as_attrdict, cfg_get
+from imaginaire_tpu.layers import LinearBlock
+
+
+class Discriminator(nn.Module):
+    dis_cfg: Any
+    data_cfg: Any = None
+
+    @nn.compact
+    def __call__(self, data, training=False):
+        dis_cfg = as_attrdict(self.dis_cfg)
+        num_labels = dis_cfg.num_labels
+        num_layers = cfg_get(dis_cfg, "num_layers", 5)
+        num_filters = cfg_get(dis_cfg, "num_filters", 512)
+        activation_norm_type = cfg_get(dis_cfg, "activation_norm_type", "batch")
+        nonlinearity = cfg_get(dis_cfg, "nonlinearity", "leakyrelu")
+
+        x = data["data"]
+        x = x.reshape(x.shape[0], -1)
+        dropout_ratio = 0.1
+        x = LinearBlock(num_filters, activation_norm_type=activation_norm_type,
+                        nonlinearity=nonlinearity, order="CNA", name="fc_in")(
+            x, training=training)
+        x = nn.Dropout(dropout_ratio, deterministic=not training)(x)
+        for n in range(num_layers):
+            dropout_ratio = float(np.minimum(dropout_ratio * 1.5, 0.5))
+            x = LinearBlock(num_filters, activation_norm_type=activation_norm_type,
+                            nonlinearity=nonlinearity, order="CNA", name=f"fc_{n}")(
+                x, training=training)
+            x = nn.Dropout(dropout_ratio, deterministic=not training)(x)
+        scores = LinearBlock(num_labels, name="fc_out")(x, training=training)
+        return {"results": scores}

@@ -1,0 +1,53 @@
+"""Global residual discriminator (ref: imaginaire/discriminators/residual.py:13-112).
+
+First conv -> [res block + 2x avg-pool] x num_layers -> aggregation
+('conv' 4x4 valid conv or global avg 'pool') -> linear classifier.
+Returns (outputs, features, images) like the reference.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from imaginaire_tpu.layers import Conv2dBlock, Res2dBlock
+
+
+class ResDiscriminator(nn.Module):
+    num_filters: int = 64
+    max_num_filters: int = 512
+    first_kernel_size: int = 1
+    num_layers: int = 4
+    padding_mode: str = "zeros"
+    activation_norm_type: str = ""
+    weight_norm_type: str = ""
+    aggregation: str = "conv"
+    order: str = "pre_act"
+
+    @nn.compact
+    def __call__(self, images, training=False):
+        common = dict(padding_mode=self.padding_mode,
+                      activation_norm_type=self.activation_norm_type,
+                      weight_norm_type=self.weight_norm_type,
+                      nonlinearity="leakyrelu")
+        nf = self.num_filters
+        first_pad = (self.first_kernel_size - 1) // 2
+        x = Conv2dBlock(nf, kernel_size=self.first_kernel_size, stride=1,
+                        padding=first_pad, name="conv_first", **common)(
+            images, training=training)
+        for i in range(self.num_layers):
+            nf = min(nf * 2, self.max_num_filters)
+            x = Res2dBlock(nf, order=self.order, name=f"res_{i}", **common)(
+                x, training=training)
+            x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        if self.aggregation == "pool":
+            x = jnp.mean(x, axis=(1, 2), keepdims=True)
+        elif self.aggregation == "conv":
+            x = Conv2dBlock(nf, kernel_size=4, stride=1, padding=0,
+                            nonlinearity="leakyrelu", name="agg")(
+                x, training=training)
+        else:
+            raise ValueError(f"The aggregation mode {self.aggregation!r} is not recognized")
+        features = x
+        outputs = nn.Dense(1, name="classifier")(x.reshape(x.shape[0], -1))
+        return outputs, features, images

@@ -1,0 +1,78 @@
+"""SPADE combined discriminator (ref: imaginaire/discriminators/spade.py):
+FPSE FPN discriminator + N multi-resolution patch discriminators over
+concat(label, image). Output list = [fpse pred2, pred3, pred4, patch...];
+features only from the patch Ds (FM loss), matching the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from imaginaire_tpu.config import as_attrdict, cfg_get
+from imaginaire_tpu.models.discriminators.fpse import FPSEDiscriminator
+from imaginaire_tpu.models.discriminators.multires_patch import (
+    NLayerPatchDiscriminator,
+    _downsample2x_bilinear,
+)
+from imaginaire_tpu.utils.data import (
+    get_paired_input_label_channel_number,
+)
+
+
+class Discriminator(nn.Module):
+    dis_cfg: Any
+    data_cfg: Any
+
+    def setup(self):
+        dis_cfg = as_attrdict(self.dis_cfg)
+        data_cfg = as_attrdict(self.data_cfg)
+        video = str(cfg_get(data_cfg, "type", "")).endswith("paired_videos")
+        num_labels = get_paired_input_label_channel_number(data_cfg, video=video)
+        num_filters = cfg_get(dis_cfg, "num_filters", 128)
+        weight_norm_type = cfg_get(dis_cfg, "weight_norm_type", "spectral")
+        self.num_discriminators = cfg_get(dis_cfg, "num_discriminators", 2)
+        self.patch_ds = [
+            NLayerPatchDiscriminator(
+                kernel_size=cfg_get(dis_cfg, "kernel_size", 3),
+                num_filters=num_filters,
+                num_layers=cfg_get(dis_cfg, "num_layers", 5),
+                max_num_filters=cfg_get(dis_cfg, "max_num_filters", 512),
+                activation_norm_type=cfg_get(dis_cfg, "activation_norm_type", "none"),
+                weight_norm_type=weight_norm_type,
+                name=f"patch_d_{i}",
+            )
+            for i in range(self.num_discriminators)
+        ]
+        self.fpse_discriminator = FPSEDiscriminator(
+            num_labels=num_labels,
+            num_filters=num_filters,
+            kernel_size=cfg_get(dis_cfg, "fpse_kernel_size", 3),
+            weight_norm_type=weight_norm_type,
+            activation_norm_type=cfg_get(dis_cfg, "fpse_activation_norm_type", "none"),
+            name="fpse",
+        )
+
+    def _single_forward(self, label, image, training):
+        """(ref: discriminators/spade.py:73-89)."""
+        pred2, pred3, pred4 = self.fpse_discriminator(image, label, training=training)
+        outputs = [pred2, pred3, pred4]
+        features_list = []
+        x = jnp.concatenate([label, image], axis=-1)
+        for i, d in enumerate(self.patch_ds):
+            logits, feats = d(x, training=training)
+            outputs.append(logits)
+            features_list.append(feats)
+            if i != self.num_discriminators - 1:
+                x = _downsample2x_bilinear(x)
+        return outputs, features_list
+
+    def __call__(self, data, net_G_output, training=False):
+        out = {}
+        out["real_outputs"], out["real_features"] = self._single_forward(
+            data["label"], data["images"], training)
+        out["fake_outputs"], out["fake_features"] = self._single_forward(
+            data["label"], net_G_output["fake_images"], training)
+        return out

@@ -1,0 +1,1 @@
+"""Generators (ref: imaginaire/generators/)."""

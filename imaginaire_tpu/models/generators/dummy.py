@@ -1,0 +1,19 @@
+"""No-op generator for the default config (ref: imaginaire/generators/dummy.py:10-29)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from flax import linen as nn
+
+
+class Generator(nn.Module):
+    gen_cfg: Any = None
+    data_cfg: Any = None
+
+    @nn.compact
+    def __call__(self, data, training=False):
+        return {}
+
+    def inference(self, variables, data):
+        return {}

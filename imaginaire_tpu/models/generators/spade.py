@@ -1,0 +1,305 @@
+"""SPADE / GauGAN generator (ref: imaginaire/generators/spade.py).
+
+Label map (+ optional VAE style code) -> image. A fixed ``base``-times
+downsampled start (16x16 for 256 output), a nearest-upsample ladder of
+SPADE residual blocks conditioned on the full-resolution label map, global
+AdaIN ("cbn") blocks conditioned on the style code, and multi-resolution
+output heads summed under tanh (ref: spade.py:401-493, heads 366-393).
+
+TPU-first notes:
+  - NHWC; every conv is a plain XLA conv that tiles onto the MXU. The
+    SPADE-internal label resizes happen once per scale and fuse with the
+    surrounding elementwise ops.
+  - The style path's stochasticity (reparameterization / random style)
+    draws from the module's 'noise' RNG stream — functional, fold-in-able
+    per data-parallel shard (SURVEY.md §7 RNG discipline).
+  - All shapes static: the 256/512/1024 variants are three compiled
+    programs selected by config, not runtime branches.
+  - The reference's 1024 head sums x256/x512/x1024 at mismatched
+    resolutions (spade.py:478-490, would shape-error if run); we upsample
+    every head to the final resolution before summing.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from imaginaire_tpu.config import as_attrdict, cfg_get
+from imaginaire_tpu.layers import Conv2dBlock, LinearBlock, Res2dBlock
+from imaginaire_tpu.utils.data import (
+    get_crop_h_w,
+    get_paired_input_image_channel_number,
+    get_paired_input_label_channel_number,
+)
+
+
+def _upsample2x(x):
+    b, h, w, c = x.shape
+    return jax.image.resize(x, (b, 2 * h, 2 * w, c), method="nearest")
+
+
+class Generator(nn.Module):
+    """Config-driven wrapper: style encoder + SPADE generator
+    (ref: spade.py:22-214)."""
+
+    gen_cfg: Any
+    data_cfg: Any
+
+    def setup(self):
+        # linen freezes dict fields into FrozenDict; restore attr access.
+        gen_cfg = as_attrdict(self.gen_cfg)
+        data_cfg = as_attrdict(self.data_cfg)
+        image_channels = get_paired_input_image_channel_number(data_cfg)
+        num_labels = get_paired_input_label_channel_number(data_cfg)
+        crop_h, crop_w = get_crop_h_w(data_cfg.train.augmentations)
+        out_small_side = min(crop_h, crop_w)
+
+        num_filters = cfg_get(gen_cfg, "num_filters", 128)
+        kernel_size = cfg_get(gen_cfg, "kernel_size", 3)
+        weight_norm_type = cfg_get(gen_cfg, "weight_norm_type", "spectral")
+        self.style_dims = cfg_get(gen_cfg, "style_dims", None)
+        self.use_style = self.style_dims is not None
+        attribute_dims = cfg_get(gen_cfg, "attribute_dims", None)
+        self.use_attribute = attribute_dims is not None
+        self.use_style_encoder = self.use_style or self.use_attribute
+        cond_dims = (self.style_dims or 0) + (attribute_dims or 0)
+
+        # SPADE norm params with the reference's defaults (spade.py:71-95).
+        anp = dict(cfg_get(gen_cfg, "activation_norm_params", None) or {})
+        anp.setdefault("num_filters", 128)
+        anp.setdefault("kernel_size", 3)
+        anp.setdefault("activation_norm_type", "sync_batch")
+        anp.setdefault("separate_projection", False)
+        anp.setdefault("weight_norm_type", weight_norm_type)
+
+        self.spade_generator = SPADEGenerator(
+            num_labels=num_labels,
+            out_image_small_side_size=out_small_side,
+            image_channels=image_channels,
+            num_filters=num_filters,
+            kernel_size=kernel_size,
+            style_dims=cond_dims,
+            activation_norm_params=anp,
+            weight_norm_type=weight_norm_type,
+            global_adaptive_norm_type=cfg_get(gen_cfg, "global_adaptive_norm_type", "sync_batch"),
+            skip_activation_norm=cfg_get(gen_cfg, "skip_activation_norm", True),
+            use_posenc_in_input_layer=cfg_get(gen_cfg, "use_posenc_in_input_layer", True),
+            use_style_encoder=self.use_style_encoder,
+        )
+        if self.use_style:
+            se_cfg = dict(cfg_get(gen_cfg, "style_enc", None) or {})
+            self.style_encoder = StyleEncoder(
+                num_filters=se_cfg.get("num_filters", 128),
+                kernel_size=se_cfg.get("kernel_size", 3),
+                style_dims=self.style_dims,
+                weight_norm_type=se_cfg.get("weight_norm_type", weight_norm_type),
+            )
+
+    def __call__(self, data, random_style=False, training=False):
+        """data: {'images': (N,H,W,C), 'label': (N,H,W,C_l), ...} ->
+        {'fake_images', 'mu', 'logvar'} (ref: spade.py:131-166)."""
+        mu = logvar = z = None
+        if self.use_style_encoder:
+            if random_style:
+                z = jax.random.normal(
+                    self.make_rng("noise"),
+                    (data["label"].shape[0], self.style_dims),
+                    dtype=jnp.float32)
+            else:
+                mu, logvar, z = self.style_encoder(data["images"], training=training,
+                                                   rng=self.make_rng("noise"))
+            if self.use_attribute:
+                z = jnp.concatenate([z, data["attributes"].reshape(z.shape[0], -1)], axis=1)
+        output = self.spade_generator(data["label"], z, training=training)
+        if self.use_style_encoder:
+            output["mu"] = mu
+            output["logvar"] = logvar
+        return output
+
+    def inference(self, data, random_style=False, **kwargs):
+        """Eval-mode forward returning fake images (ref: spade.py:168-214)."""
+        out = self(data, random_style=random_style, training=False)
+        return out["fake_images"]
+
+
+class SPADEGenerator(nn.Module):
+    """The up-ladder core (ref: spade.py:217-493)."""
+
+    num_labels: int
+    out_image_small_side_size: int
+    image_channels: int
+    num_filters: int
+    kernel_size: int
+    style_dims: int
+    activation_norm_params: Any
+    weight_norm_type: str
+    global_adaptive_norm_type: str
+    skip_activation_norm: bool
+    use_posenc_in_input_layer: bool
+    use_style_encoder: bool
+
+    @property
+    def base(self):
+        return {256: 16, 512: 32, 1024: 64}[self.out_image_small_side_size]
+
+    @nn.compact
+    def __call__(self, seg, z=None, training=False):
+        if self.out_image_small_side_size not in (256, 512, 1024):
+            raise ValueError(
+                f"Generation image size {self.out_image_small_side_size} not supported")
+        nf = self.num_filters
+        ks = self.kernel_size
+        pad = int(math.ceil((ks - 1.0) / 2))
+
+        def res_block(out_ch, name):
+            return Res2dBlock(
+                out_ch, kernel_size=ks, padding=pad, bias=[True, True, False],
+                weight_norm_type=self.weight_norm_type,
+                activation_norm_type="spatially_adaptive",
+                activation_norm_params=self.activation_norm_params,
+                skip_activation_norm=self.skip_activation_norm,
+                nonlinearity="leakyrelu", order="NACNAC", name=name)
+
+        def cbn_block(out_ch, name):
+            # Global AdaIN-conditioned conv (ref: spade.py:287-307).
+            return Conv2dBlock(
+                out_ch, kernel_size=ks, stride=1, padding=pad, bias=True,
+                weight_norm_type=self.weight_norm_type,
+                activation_norm_type="adaptive",
+                activation_norm_params={
+                    "activation_norm_type": self.global_adaptive_norm_type,
+                    "weight_norm_type": self.activation_norm_params.get("weight_norm_type", ""),
+                    "separate_projection": self.activation_norm_params.get(
+                        "separate_projection", False),
+                },
+                nonlinearity="leakyrelu", order="NAC", name=name)
+
+        def plain_block(out_ch, name):
+            return Conv2dBlock(
+                out_ch, kernel_size=ks, stride=1, padding=pad, bias=True,
+                weight_norm_type=self.weight_norm_type,
+                nonlinearity="leakyrelu", order="NAC", name=name)
+
+        def img_head(name):
+            return Conv2dBlock(
+                self.image_channels, 5, stride=1, padding=2,
+                weight_norm_type=self.weight_norm_type,
+                activation_norm_type="none", nonlinearity="leakyrelu",
+                order="ANC", name=name)
+
+        if self.use_style_encoder:
+            z = LinearBlock(2 * self.style_dims, weight_norm_type=self.weight_norm_type,
+                            nonlinearity="relu", order="CAN", name="fc_0")(z, training=training)
+            z = LinearBlock(2 * self.style_dims, weight_norm_type=self.weight_norm_type,
+                            nonlinearity="relu", order="CAN", name="fc_1")(z, training=training)
+
+        # Start at (H/base, W/base) — 16x16 for square 256 (ref: spade.py:420-430).
+        n, h, w, _ = seg.shape
+        sy, sx = h // self.base, w // self.base
+        in_seg = jax.image.resize(seg, (n, sy, sx, seg.shape[-1]), method="nearest")
+        if self.use_posenc_in_input_layer:
+            # Bicubically-resized xy ramp in [-1, 1] (ref: spade.py:396-399,425-428).
+            xv, yv = jnp.meshgrid(jnp.linspace(-1, 1, 16), jnp.linspace(-1, 1, 16),
+                                  indexing="ij")
+            xy = jnp.stack([xv, yv], axis=-1)[None]
+            in_xy = jax.image.resize(xy, (1, sy, sx, 2), method="cubic")
+            in_seg = jnp.concatenate(
+                [in_seg, jnp.broadcast_to(in_xy, (n, sy, sx, 2)).astype(in_seg.dtype)], axis=-1)
+
+        x = Conv2dBlock(8 * nf, kernel_size=ks, stride=1, padding=pad,
+                        weight_norm_type=self.weight_norm_type,
+                        activation_norm_type="none", nonlinearity="leakyrelu",
+                        name="head_0")(in_seg, training=training)
+        if self.use_style_encoder:
+            x = cbn_block(16 * nf, "cbn_head_0")(x, z, training=training)
+        else:
+            x = plain_block(16 * nf, "conv_head_0")(x, training=training)
+        x = res_block(16 * nf, "head_1")(x, seg, training=training)
+        x = res_block(16 * nf, "head_2")(x, seg, training=training)
+        x = _upsample2x(x)
+        # 32x32
+        x = res_block(8 * nf, "up_0a")(x, seg, training=training)
+        if self.use_style_encoder:
+            x = cbn_block(8 * nf, "cbn_up_0a")(x, z, training=training)
+        else:
+            x = plain_block(8 * nf, "conv_up_0a")(x, training=training)
+        x = res_block(8 * nf, "up_0b")(x, seg, training=training)
+        x = _upsample2x(x)
+        # 64x64
+        x = res_block(4 * nf, "up_1a")(x, seg, training=training)
+        if self.use_style_encoder:
+            x = cbn_block(4 * nf, "cbn_up_1a")(x, z, training=training)
+        else:
+            x = plain_block(4 * nf, "conv_up_1a")(x, training=training)
+        x = res_block(4 * nf, "up_1b")(x, seg, training=training)
+        x = _upsample2x(x)
+        # 128x128
+        x = res_block(4 * nf, "up_2a")(x, seg, training=training)
+        if self.use_style_encoder:
+            x = cbn_block(4 * nf, "cbn_up_2a")(x, z, training=training)
+        else:
+            x = plain_block(4 * nf, "conv_up_2a")(x, training=training)
+        x = res_block(2 * nf, "up_2b")(x, seg, training=training)
+        x = _upsample2x(x)
+
+        size = self.out_image_small_side_size
+        if size == 256:
+            out = jnp.tanh(img_head("conv_img256")(x, training=training))
+        else:
+            x256 = img_head("conv_img256")(x, training=training)
+            x = res_block(1 * nf, "up_3a")(x, seg, training=training)
+            x = res_block(1 * nf, "up_3b")(x, seg, training=training)
+            x = _upsample2x(x)
+            x512 = img_head("conv_img512")(x, training=training)
+            if size == 512:
+                out = jnp.tanh(_upsample2x(x256) + x512)
+            else:
+                x = res_block(nf // 2, "up_4a")(x, seg, training=training)
+                x = res_block(nf // 2, "up_4b")(x, seg, training=training)
+                x = _upsample2x(x)
+                x1024 = img_head("conv_img1024")(x, training=training)
+                out = jnp.tanh(
+                    _upsample2x(_upsample2x(x256)) + _upsample2x(x512) + x1024)
+        return {"fake_images": out}
+
+
+class StyleEncoder(nn.Module):
+    """VAE-style encoder: 6 stride-2 convs + fc_mu/fc_var + reparam
+    (ref: spade.py:496-563)."""
+
+    num_filters: int = 128
+    kernel_size: int = 3
+    style_dims: int = 256
+    weight_norm_type: str = "spectral"
+
+    @nn.compact
+    def __call__(self, x, training=False, rng=None):
+        nf = self.num_filters
+        ks = self.kernel_size
+        pad = int(math.ceil((ks - 1.0) / 2))
+
+        def enc(out_ch, name):
+            return Conv2dBlock(out_ch, kernel_size=ks, stride=2, padding=pad,
+                               weight_norm_type=self.weight_norm_type,
+                               activation_norm_type="none",
+                               nonlinearity="leakyrelu", name=name)
+
+        n, h, w, c = x.shape
+        if (h, w) != (256, 256):
+            x = jax.image.resize(x, (n, 256, 256, c), method="bilinear")
+        for i, ch in enumerate([nf, 2 * nf, 4 * nf, 8 * nf, 8 * nf, 8 * nf]):
+            x = enc(ch, f"layer{i + 1}")(x, training=training)
+        x = x.reshape(n, -1)
+        mu = LinearBlock(self.style_dims, name="fc_mu")(x, training=training)
+        logvar = LinearBlock(self.style_dims, name="fc_var")(x, training=training)
+        std = jnp.exp(0.5 * logvar)
+        if rng is None:
+            rng = self.make_rng("noise")
+        eps = jax.random.normal(rng, std.shape, dtype=std.dtype)
+        z = eps * std + mu
+        return mu, logvar, z

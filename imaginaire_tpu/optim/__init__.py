@@ -1,0 +1,16 @@
+"""Optimizers + schedules (ref: imaginaire/optimizers/{fromage,madam}.py,
+imaginaire/utils/trainer.py:219-306).
+
+optax GradientTransformations. 'fused' variants in the reference are a
+CUDA concern — under XLA every optimizer is fused into the train step, so
+``fused_opt`` is accepted and ignored.
+"""
+
+from imaginaire_tpu.optim.optimizers import (
+    fromage,
+    get_optimizer_for_params,
+    get_scheduler,
+    madam,
+)
+
+__all__ = ["fromage", "madam", "get_optimizer_for_params", "get_scheduler"]

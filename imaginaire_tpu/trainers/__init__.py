@@ -1,0 +1,5 @@
+"""Trainer harness (ref: imaginaire/trainers/)."""
+
+from imaginaire_tpu.trainers.base import BaseTrainer
+
+__all__ = ["BaseTrainer"]

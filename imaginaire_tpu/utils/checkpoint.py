@@ -1,0 +1,64 @@
+"""Checkpoint save/load with the reference's pointer-file contract
+(ref: imaginaire/trainers/base.py:199-265, 790-829; SURVEY.md §5.4).
+
+orbax handles the array serialization (async-capable, preemption-safe —
+the idiomatic TPU upgrade over torch.save); the surrounding protocol is
+kept bit-compatible in spirit:
+  - checkpoints at ``<logdir>/epoch_EEEEE_iteration_IIIIIIIII_checkpoint``
+  - ``<logdir>/latest_checkpoint.txt`` holds the latest checkpoint name
+  - resume mode restores everything; weights-only mode restores params
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import jax
+import orbax.checkpoint as ocp
+
+from imaginaire_tpu.parallel.mesh import is_master
+
+_POINTER = "latest_checkpoint.txt"
+
+
+def checkpoint_name(epoch, iteration):
+    return f"epoch_{epoch:05d}_iteration_{iteration:09d}_checkpoint"
+
+
+def parse_checkpoint_name(name):
+    m = re.search(r"epoch_(\d+)_iteration_(\d+)", os.path.basename(name))
+    if not m:
+        return 0, 0
+    return int(m.group(1)), int(m.group(2))
+
+
+def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None):
+    """Master-writes state pytree + pointer file (ref: base.py:790-829)."""
+    name = checkpoint_name(epoch, iteration)
+    path = os.path.abspath(os.path.join(logdir, name))
+    with ocp.PyTreeCheckpointer() as ckpt:
+        ckpt.save(path, jax.device_get(state))
+    if is_master():
+        with open(os.path.join(logdir, _POINTER), "w") as f:
+            f.write(name + "\n")
+    return path
+
+
+def latest_checkpoint_path(logdir):
+    """(ref: base.py:225-233)."""
+    pointer = os.path.join(logdir, _POINTER)
+    if not os.path.exists(pointer):
+        return None
+    with open(pointer) as f:
+        name = f.read().strip()
+    path = os.path.join(logdir, name)
+    return path if os.path.exists(path) else None
+
+
+def load_checkpoint(path, target=None):
+    """Restore a state pytree; ``target`` gives structure/dtypes."""
+    with ocp.PyTreeCheckpointer() as ckpt:
+        if target is not None:
+            return ckpt.restore(os.path.abspath(path), item=jax.device_get(target))
+        return ckpt.restore(os.path.abspath(path))

@@ -1,0 +1,34 @@
+"""Log-directory conventions (ref: imaginaire/utils/logging.py:21-51):
+``logs/<date_uid>_<config_name>`` with a ``tensorboard/`` subdir,
+master-only creation."""
+
+from __future__ import annotations
+
+import os
+from datetime import datetime
+
+from imaginaire_tpu.parallel.mesh import is_master
+from imaginaire_tpu.utils.meters import set_summary_writer
+
+
+def get_date_uid():
+    return datetime.now().strftime("%Y_%m%d_%H%M_%S")
+
+
+def init_logging(config_path, logdir=None, root="logs"):
+    """(ref: logging.py:21-38)."""
+    config_file = os.path.basename(config_path)
+    date_uid = get_date_uid()
+    if logdir is None:
+        logdir = os.path.join(root, f"{date_uid}_{os.path.splitext(config_file)[0]}")
+    return date_uid, logdir
+
+
+def make_logging_dir(logdir):
+    """(ref: logging.py:41-51)."""
+    if is_master():
+        os.makedirs(logdir, exist_ok=True)
+        tb_dir = os.path.join(logdir, "tensorboard")
+        os.makedirs(tb_dir, exist_ok=True)
+        set_summary_writer(tb_dir)
+    return logdir

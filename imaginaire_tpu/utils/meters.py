@@ -1,0 +1,66 @@
+"""Meters -> TensorBoard (ref: imaginaire/utils/meters.py).
+
+Same contract as the reference: ``Meter.write`` buffers values,
+``flush`` averages them, filters non-finite with a console warning, and
+writes a scalar per meter (ref: meters.py:107-145). Master-process-only,
+like every reference writer.
+"""
+
+from __future__ import annotations
+
+import math
+
+from imaginaire_tpu.parallel.mesh import is_master, master_only
+
+_WRITER = None
+
+
+@master_only
+def set_summary_writer(log_dir):
+    """(ref: meters.py:55-60)."""
+    global _WRITER
+    from torch.utils.tensorboard import SummaryWriter
+
+    _WRITER = SummaryWriter(log_dir=log_dir)
+
+
+def get_summary_writer():
+    return _WRITER
+
+
+@master_only
+def write_summary(name, data, step, hist=False):
+    """(ref: meters.py:63-78)."""
+    if _WRITER is None:
+        return
+    if hist:
+        _WRITER.add_histogram(name, data, step)
+    else:
+        _WRITER.add_scalar(name, data, step)
+
+
+class Meter:
+    """(ref: meters.py:107-159)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.values = []
+
+    def reset(self):
+        self.values = []
+
+    def write(self, value):
+        if value is not None:
+            self.values.append(float(value))
+
+    def write_image(self, img_grid, step):
+        if is_master() and _WRITER is not None:
+            _WRITER.add_image(self.name, img_grid, step, dataformats="HWC")
+
+    def flush(self, step):
+        values = [v for v in self.values if math.isfinite(v)]
+        if len(values) != len(self.values):
+            print(f"meter {self.name} has non-finite values")
+        if values:
+            write_summary(self.name, sum(values) / len(values), step)
+        self.reset()

@@ -1,0 +1,74 @@
+"""Exponential moving average of generator params with spectral-norm
+collapse (ref: imaginaire/utils/model_average.py:35-197).
+
+Functional version: the EMA is just another params pytree in the train
+state. The reference's ``remove_sn`` mode materializes the
+sigma-normalized weight into the averaged copy (sn_compute_weight,
+ref: model_average.py:183-197) so the EMA model needs no power-iteration
+state at inference; ``collapse_spectral_norm`` does the same by walking
+the 'spectral' variable collection alongside 'params'.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _normalize(v, eps=1e-12):
+    return v / (jnp.linalg.norm(v) + eps)
+
+
+def collapse_spectral_norm(params, spectral):
+    """Return params with every spectrally-normalized kernel divided by its
+    current sigma (estimated from the stored power-iteration ``u``).
+
+    ``spectral`` mirrors the module tree with ``{'u': vec}`` leaves at the
+    scopes that own a ``kernel`` param (see layers/weight_norm.py).
+    """
+    if spectral is None:
+        return params
+
+    def walk(p_node, s_node):
+        if not isinstance(p_node, dict):
+            return p_node
+        out = {}
+        for k, v in p_node.items():
+            s_child = s_node.get(k) if isinstance(s_node, dict) else None
+            if isinstance(v, dict):
+                out[k] = walk(v, s_child or {})
+            else:
+                out[k] = v
+        if isinstance(s_node, dict) and "u" in s_node and "kernel" in out:
+            kernel = out["kernel"]
+            u = s_node["u"]
+            w_mat = kernel.reshape(-1, kernel.shape[-1]).T  # (out, rest)
+            v = _normalize(w_mat.T @ u)
+            u2 = _normalize(w_mat @ v)
+            sigma = jnp.einsum("o,or,r->", u2, w_mat, v)
+            out["kernel"] = kernel / sigma
+        return out
+
+    return walk(dict(params), dict(spectral))
+
+
+def ema_init(params, spectral=None, remove_sn=True):
+    """Initialize the averaged copy (ref: model_average.py:48-81)."""
+    src = collapse_spectral_norm(params, spectral) if remove_sn else params
+    return jax.tree_util.tree_map(jnp.asarray, src)
+
+
+def ema_update(avg_params, params, num_updates, beta=0.9999,
+               start_iteration=1000, spectral=None, remove_sn=True):
+    """One EMA step (ref: model_average.py:87-130): beta=0 (pure copy)
+    until start_iteration, then exponential averaging. With remove_sn the
+    source weights are sigma-collapsed first, so ``avg_params`` always
+    holds inference-ready weights.
+
+    num_updates is the post-increment counter (reference increments before
+    comparing).
+    """
+    src = collapse_spectral_norm(params, spectral) if remove_sn else params
+    b = jnp.where(num_updates <= start_iteration, 0.0, beta)
+    return jax.tree_util.tree_map(
+        lambda a, p: a * b + p * (1.0 - b), avg_params, src)

@@ -1,0 +1,12 @@
+"""Visualization helpers (ref: imaginaire/utils/visualization/)."""
+
+from imaginaire_tpu.utils.visualization.common import (
+    save_image_grid,
+    save_tensor_strip,
+    tensor2flow,
+    tensor2im,
+    tensor2label,
+)
+
+__all__ = ["tensor2im", "tensor2label", "tensor2flow", "save_image_grid",
+           "save_tensor_strip"]

@@ -1,0 +1,99 @@
+"""Tensor -> image conversions (ref: imaginaire/utils/visualization/common.py).
+
+NHWC numpy in, uint8 numpy / PIL out. ``tensor2im`` maps [-1,1] to uint8;
+``tensor2label`` colorizes one-hot label maps with a stable palette;
+``tensor2flow`` renders optical flow with the HSV wheel
+(ref: visualization/common.py:156+).
+"""
+
+from __future__ import annotations
+
+import colorsys
+
+import numpy as np
+from PIL import Image
+
+
+def tensor2im(image, minus1to1_normalized=True):
+    """(H,W,C) float in [-1,1] (or [0,1]) -> uint8 RGB."""
+    img = np.asarray(image, dtype=np.float32)
+    if minus1to1_normalized:
+        img = (img + 1.0) / 2.0
+    img = np.clip(img, 0.0, 1.0) * 255.0
+    if img.shape[-1] == 1:
+        img = np.repeat(img, 3, axis=-1)
+    return img[..., :3].astype(np.uint8)
+
+
+def _label_palette(n):
+    # Stable golden-angle hue walk — deterministic, well-separated colors.
+    colors = [(0, 0, 0)]
+    for i in range(1, n):
+        h = (i * 0.618033988749895) % 1.0
+        r, g, b = colorsys.hsv_to_rgb(h, 0.75, 0.95)
+        colors.append((int(r * 255), int(g * 255), int(b * 255)))
+    return np.asarray(colors, dtype=np.uint8)
+
+
+def tensor2label(label_map, num_labels=None):
+    """One-hot (H,W,C) or index (H,W) label map -> colorized uint8 RGB
+    (ref: visualization/common.py tensor2label)."""
+    lab = np.asarray(label_map)
+    if lab.ndim == 3 and lab.shape[-1] > 1:
+        idx = lab.argmax(axis=-1)
+        n = num_labels or lab.shape[-1]
+    else:
+        idx = lab.squeeze(-1).astype(np.int32) if lab.ndim == 3 else lab.astype(np.int32)
+        n = num_labels or int(idx.max()) + 1
+    return _label_palette(max(n, 1))[idx]
+
+
+def tensor2flow(flow):
+    """(H,W,2) flow -> HSV-wheel uint8 RGB (ref: visualization/common.py:156)."""
+    flow = np.asarray(flow, dtype=np.float32)
+    dx, dy = flow[..., 0], flow[..., 1]
+    mag = np.sqrt(dx ** 2 + dy ** 2)
+    ang = np.arctan2(dy, dx)
+    h = (ang / (2 * np.pi) + 0.5) % 1.0
+    s = np.ones_like(h)
+    v = np.clip(mag / (mag.max() + 1e-6), 0, 1)
+    hsv = np.stack([h, s, v], axis=-1)
+    # vectorized hsv->rgb
+    i = (hsv[..., 0] * 6).astype(np.int32) % 6
+    f = hsv[..., 0] * 6 - np.floor(hsv[..., 0] * 6)
+    p = hsv[..., 2] * (1 - hsv[..., 1])
+    q = hsv[..., 2] * (1 - f * hsv[..., 1])
+    t = hsv[..., 2] * (1 - (1 - f) * hsv[..., 1])
+    vch = hsv[..., 2]
+    rgb = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([vch, t, p], -1), np.stack([q, vch, p], -1),
+         np.stack([p, vch, t], -1), np.stack([p, q, vch], -1),
+         np.stack([t, p, vch], -1), np.stack([vch, p, q], -1)])
+    return (rgb * 255).astype(np.uint8)
+
+
+def save_image_grid(images, path, cols=None):
+    """Save a list of HWC uint8 images as one horizontal strip / grid."""
+    images = [np.asarray(im) for im in images]
+    h = max(im.shape[0] for im in images)
+    w = max(im.shape[1] for im in images)
+    cols = cols or len(images)
+    rows = (len(images) + cols - 1) // cols
+    canvas = np.zeros((rows * h, cols * w, 3), dtype=np.uint8)
+    for i, im in enumerate(images):
+        r, c = divmod(i, cols)
+        canvas[r * h:r * h + im.shape[0], c * w:c * w + im.shape[1]] = im[..., :3]
+    Image.fromarray(canvas).save(path, quality=95)
+    return path
+
+
+def save_tensor_strip(tensors, path):
+    """Horizontally-concatenated (input, label, fake, ...) batch snapshot
+    (ref: trainers/base.py:445-465): one row per batch element."""
+    rows = []
+    for batch in tensors:
+        batch = np.asarray(batch)
+        rows.append([tensor2im(batch[i]) for i in range(batch.shape[0])])
+    images = [im for col in zip(*rows) for im in col]
+    return save_image_grid(images, path, cols=len(tensors))

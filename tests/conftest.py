@@ -7,14 +7,30 @@ exactly the SPMD partitioning and collectives that run on a TPU slice.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the environment pre-sets JAX_PLATFORMS=axon (remote TPU
+# tunnel), which makes every test compile over the wire. Unit tests always
+# run on the virtual CPU mesh; bench.py uses the real chip.
+#
+# NOTE: sitecustomize.py (axon boot) imports jax at interpreter start, so
+# setting os.environ here is too late for the env-var path — we must also
+# set the config knob, which still works because backends aren't
+# initialized until first use.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", (
+    "tests must run on the virtual CPU mesh, got " + str(jax.devices()))
+
 jax.config.update("jax_threefry_partitionable", True)
+# Persistent compilation cache: model-level tests compile big graphs;
+# repeat runs hit the cache instead of recompiling.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

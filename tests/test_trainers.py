@@ -1,0 +1,153 @@
+"""Trainer harness tests: 2-iteration SPADE training on synthetic data
+(mirrors the reference's scripts/test_training.sh 2-iter smoke strategy,
+SURVEY.md §4) plus optimizer/EMA unit tests."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from imaginaire_tpu.config import AttrDict, Config
+from imaginaire_tpu.optim import fromage, get_optimizer_for_params, get_scheduler, madam
+from imaginaire_tpu.utils.model_average import collapse_spectral_norm, ema_init, ema_update
+
+CFG_PATH = os.path.join(os.path.dirname(__file__), "..", "configs", "unit_test", "spade.yaml")
+
+
+def synthetic_batch(rng, h=256, w=256, labels=14):
+    # 12 seg channels + 1 dont-care + 1 edge = 14 label channels.
+    return {
+        "images": jnp.asarray(rng.rand(1, h, w, 3).astype(np.float32)) * 2 - 1,
+        "label": jnp.asarray((rng.rand(1, h, w, labels) > 0.9).astype(np.float32)),
+    }
+
+
+class TestOptimizers:
+    def test_fromage_matches_reference_step(self, rng):
+        lr = 0.01
+        p = {"w": jnp.asarray(rng.randn(4, 3).astype(np.float32))}
+        g = {"w": jnp.asarray(rng.randn(4, 3).astype(np.float32))}
+        tx = fromage(lr)
+        upd, _ = tx.update(g, tx.init(p), p)
+        new_p = optax.apply_updates(p, upd)
+        pw, gw = np.asarray(p["w"]), np.asarray(g["w"])
+        want = (pw - lr * gw * (np.linalg.norm(pw) / np.linalg.norm(gw)))
+        want /= np.sqrt(1 + lr ** 2)
+        np.testing.assert_allclose(np.asarray(new_p["w"]), want, rtol=1e-5)
+
+    def test_madam_bounded_multiplicative(self, rng):
+        p = {"w": jnp.asarray(rng.randn(8).astype(np.float32))}
+        tx = madam(0.01, scale=3.0)
+        state = tx.init(p)
+        g = {"w": jnp.asarray(rng.randn(8).astype(np.float32))}
+        upd, state = tx.update(g, state, p)
+        new_p = optax.apply_updates(p, upd)
+        bound = 3.0 * np.sqrt((np.asarray(p["w"]) ** 2).mean())
+        assert np.all(np.abs(np.asarray(new_p["w"])) <= bound + 1e-6)
+        # sign never flips under multiplicative update
+        assert np.all(np.sign(new_p["w"]) == np.sign(p["w"]))
+
+    def test_step_scheduler(self):
+        cfg_opt = AttrDict({"lr_policy": {"type": "step", "step_size": 2, "gamma": 0.1}})
+        sched = get_scheduler(cfg_opt, iters_per_epoch=10)
+        assert sched(0) == 1.0
+        assert sched(19) == 1.0
+        np.testing.assert_allclose(sched(20), 0.1)
+        np.testing.assert_allclose(sched(45), 0.01)
+
+    def test_factory_adam(self):
+        cfg_opt = AttrDict({"type": "adam", "lr": 1e-3, "adam_beta1": 0.5})
+        tx = get_optimizer_for_params(cfg_opt)
+        p = {"w": jnp.ones(3)}
+        upd, _ = tx.update({"w": jnp.ones(3)}, tx.init(p), p)
+        assert np.all(np.isfinite(np.asarray(upd["w"])))
+
+
+class TestEMA:
+    def test_copy_then_average(self):
+        p = {"k": jnp.ones(3)}
+        avg = ema_init(p, None, remove_sn=False)
+        # before start_iteration: pure copy of source
+        p2 = {"k": jnp.full((3,), 2.0)}
+        avg = ema_update(avg, p2, num_updates=1, beta=0.9, start_iteration=5,
+                         remove_sn=False)
+        np.testing.assert_allclose(avg["k"], 2.0)
+        # after: exponential average
+        p3 = {"k": jnp.full((3,), 3.0)}
+        avg = ema_update(avg, p3, num_updates=10, beta=0.9, start_iteration=5,
+                         remove_sn=False)
+        np.testing.assert_allclose(np.asarray(avg["k"]), 0.9 * 2.0 + 0.1 * 3.0, rtol=1e-6)
+
+    def test_sn_collapse_divides_by_sigma(self, rng):
+        k = rng.randn(3, 3, 4, 8).astype(np.float32)
+        params = {"conv": {"kernel": jnp.asarray(k), "bias": jnp.zeros(8)}}
+        u = rng.randn(8).astype(np.float32)
+        u /= np.linalg.norm(u)
+        spectral = {"conv": {"u": jnp.asarray(u)}}
+        out = collapse_spectral_norm(params, spectral)
+        w = k.reshape(-1, 8).T
+        v = w.T @ u
+        v /= np.linalg.norm(v)
+        u2 = w @ v
+        u2 /= np.linalg.norm(u2)
+        sigma = u2 @ w @ v
+        np.testing.assert_allclose(np.asarray(out["conv"]["kernel"]),
+                                   k / sigma, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(out["conv"]["bias"]), 0.0)
+
+
+@pytest.mark.slow
+class TestSPADETraining:
+    def test_two_iterations(self, rng, tmp_path):
+        cfg = Config(CFG_PATH)
+        cfg.logdir = str(tmp_path)
+        # shrink for test speed
+        from imaginaire_tpu.registry import resolve
+
+        trainer_cls = resolve(cfg.trainer.type, "Trainer")
+        trainer = trainer_cls(cfg)
+        data = synthetic_batch(rng)
+        key = jax.random.PRNGKey(0)
+        trainer.init_state(key, data)
+
+        trainer.start_of_epoch(0)
+        losses_hist = []
+        for it in range(1, 3):
+            batch = trainer.start_of_iteration(synthetic_batch(rng), it)
+            d_losses = trainer.dis_update(batch)
+            g_losses = trainer.gen_update(batch)
+            trainer.end_of_iteration(batch, 0, it)
+            losses_hist.append((d_losses, g_losses))
+        for d_losses, g_losses in losses_hist:
+            for name, v in {**d_losses, **g_losses}.items():
+                assert np.isfinite(float(jax.device_get(v))), name
+        # all loss terms present
+        assert {"GAN", "FeatureMatching", "GaussianKL", "Perceptual", "total"} <= set(
+            losses_hist[0][1].keys())
+
+    def test_checkpoint_roundtrip(self, rng, tmp_path):
+        cfg = Config(CFG_PATH)
+        cfg.logdir = str(tmp_path)
+        cfg.trainer.model_average = True
+        cfg.trainer.model_average_start_iteration = 1
+        from imaginaire_tpu.registry import resolve
+
+        trainer_cls = resolve(cfg.trainer.type, "Trainer")
+        trainer = trainer_cls(cfg)
+        data = synthetic_batch(rng)
+        trainer.init_state(jax.random.PRNGKey(0), data)
+        batch = trainer.start_of_iteration(synthetic_batch(rng), 1)
+        trainer.gen_update(batch)
+        trainer.save_checkpoint(0, 1)
+
+        trainer2 = trainer_cls(cfg)
+        trainer2.init_state(jax.random.PRNGKey(1), data)
+        assert trainer2.load_checkpoint()
+        a = jax.tree_util.tree_leaves(trainer.state["vars_G"]["params"])
+        b = jax.tree_util.tree_leaves(trainer2.state["vars_G"]["params"])
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-6)
+        assert trainer2.current_iteration == 1

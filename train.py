@@ -1,0 +1,84 @@
+"""Training entry point (ref: train.py:33-94).
+
+argparse -> Config -> mesh init -> dataloaders -> trainer -> epoch/iter
+loop with dis_step/gen_step multipliers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+
+from imaginaire_tpu.config import Config, cfg_get
+from imaginaire_tpu.data import get_train_and_val_dataloader
+from imaginaire_tpu.parallel.mesh import create_mesh, master_only_print as print, set_mesh
+from imaginaire_tpu.registry import resolve
+from imaginaire_tpu.utils.logging_utils import init_logging, make_logging_dir
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description="imaginaire-tpu training")
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--logdir", default=None)
+    parser.add_argument("--checkpoint", default="")
+    parser.add_argument("--seed", type=int, default=2)
+    parser.add_argument("--max_iter", type=int, default=None,
+                        help="override cfg max_iter (smoke tests)")
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    cfg = Config(args.config)
+    if args.max_iter is not None:
+        cfg.max_iter = args.max_iter
+
+    set_mesh(create_mesh(tuple(cfg.runtime.mesh.axes), cfg.runtime.mesh.shape))
+    date_uid, logdir = init_logging(args.config, args.logdir)
+    make_logging_dir(logdir)
+    cfg.logdir = logdir
+
+    train_loader, val_loader = get_train_and_val_dataloader(cfg, seed=args.seed)
+    trainer_cls = resolve(cfg.trainer.type, "Trainer")
+    trainer = trainer_cls(cfg, train_data_loader=train_loader,
+                          val_data_loader=val_loader)
+
+    sample = next(iter(train_loader))
+    sample = trainer.start_of_iteration(sample, 0)
+    trainer.init_state(jax.random.PRNGKey(args.seed), sample)
+    if args.checkpoint:
+        trainer.load_checkpoint(args.checkpoint)
+    else:
+        trainer.load_checkpoint()  # resume from pointer file if present
+
+    current_iteration = trainer.current_iteration
+    current_epoch = trainer.current_epoch
+    max_iter = cfg_get(cfg, "max_iter", 1000000)
+    max_epoch = cfg_get(cfg, "max_epoch", 200)
+    dis_steps = cfg_get(cfg.trainer, "dis_step", 1)
+    gen_steps = cfg_get(cfg.trainer, "gen_step", 1)
+
+    for epoch in range(current_epoch, max_epoch):
+        print(f"Epoch {epoch} ...")
+        train_loader.set_epoch(epoch)
+        trainer.start_of_epoch(epoch)
+        for it, data in enumerate(train_loader):
+            data = trainer.start_of_iteration(data, current_iteration)
+            for _ in range(dis_steps):
+                trainer.dis_update(data)
+            for _ in range(gen_steps):
+                trainer.gen_update(data)
+            current_iteration += 1
+            trainer.end_of_iteration(data, epoch, current_iteration)
+            if current_iteration >= max_iter:
+                print("Done with training!!!")
+                trainer.save_checkpoint(epoch, current_iteration)
+                return
+        trainer.end_of_epoch(data, epoch, current_iteration)
+    print("Done with training!!!")
+
+
+if __name__ == "__main__":
+    main()
