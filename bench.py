@@ -38,8 +38,10 @@ def build():
     cfg.trainer.perceptual_loss = {
         "mode": "vgg19",
         "layers": ["relu_1_1", "relu_2_1", "relu_3_1", "relu_4_1", "relu_5_1"],
-        "weights": [0.03125, 0.0625, 0.125, 0.25, 1.0]}
+        "weights": [0.03125, 0.0625, 0.125, 0.25, 1.0],
+        "allow_random_init": True}
     cfg.trainer.model_average = True
+    cfg.trainer.compute_dtype = "bfloat16"
     cfg.gen = {
         "type": "imaginaire_tpu.models.generators.spade",
         "style_dims": 256, "num_filters": 64, "kernel_size": 3,

@@ -1,0 +1,24 @@
+"""Evaluation metrics (ref: imaginaire/evaluation/): FID, KID, PRDC over
+Inception-v3 activations."""
+
+from imaginaire_tpu.evaluation.common import (
+    get_activations,
+    get_video_activations,
+    preprocess_for_inception,
+)
+from imaginaire_tpu.evaluation.fid import (
+    calculate_frechet_distance,
+    compute_fid,
+    load_or_compute_stats,
+)
+from imaginaire_tpu.evaluation.inception import InceptionV3, load_params, make_extractor
+from imaginaire_tpu.evaluation.kid import compute_kid, kid_from_activations
+from imaginaire_tpu.evaluation.prdc import compute_prdc, prdc_from_activations
+
+__all__ = [
+    "get_activations", "get_video_activations", "preprocess_for_inception",
+    "calculate_frechet_distance", "compute_fid", "load_or_compute_stats",
+    "InceptionV3", "load_params", "make_extractor",
+    "compute_kid", "kid_from_activations",
+    "compute_prdc", "prdc_from_activations",
+]

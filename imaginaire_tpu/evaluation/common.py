@@ -1,0 +1,93 @@
+"""Activation harness for metrics (ref: imaginaire/evaluation/common.py).
+
+``get_activations`` loops a loader, optionally runs the generator, then
+imagenet-normalizes, resizes to 299, and feeds the Inception extractor
+(ref: common.py:15-76). ``get_video_activations`` shards sequences
+round-robin across host processes and rolls the trainer frame by frame
+(ref: common.py:79-158).
+
+Cross-process gather: the reference all-gathers per-rank activations
+(ref: common.py:68, dist_all_gather_tensor); the multi-host equivalent is
+``multihost_utils.process_allgather``. Single-process runs skip it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from imaginaire_tpu.utils.misc import apply_imagenet_normalization
+
+
+def preprocess_for_inception(images):
+    """[-1,1] NHWC float -> imagenet-normalized 299x299 (ref: common.py:44-60).
+
+    Only the first 3 channels are used (fork 4-channel support,
+    ref: evaluation/common.py:60 — handled inside
+    apply_imagenet_normalization).
+    """
+    x = apply_imagenet_normalization(jnp.clip(images, -1.0, 1.0))
+    b, h, w, c = x.shape
+    if (h, w) != (299, 299):
+        x = jax.image.resize(x, (b, 299, 299, c), method="bilinear")
+    return x
+
+
+def _allgather_if_multihost(acts):
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(acts)).reshape(
+            -1, acts.shape[-1])
+    return acts
+
+
+def get_activations(data_loader, key_real, key_fake, extractor,
+                    generator_fn=None, max_batches=None):
+    """Per-host activation loop (ref: common.py:15-76).
+
+    generator_fn: data -> fake images in [-1,1] NHWC, or None to read
+    ``data[key_real]`` directly. Returns np (N, 2048) gathered over hosts.
+    """
+    acts = []
+    for it, data in enumerate(data_loader):
+        if max_batches is not None and it >= max_batches:
+            break
+        if generator_fn is None:
+            images = jnp.asarray(np.asarray(data[key_real]))
+        else:
+            images = generator_fn(data)
+        feats = extractor(preprocess_for_inception(images))
+        acts.append(np.asarray(feats))
+    if not acts:
+        return np.zeros((0, 2048), np.float32)
+    return _allgather_if_multihost(np.concatenate(acts, axis=0))
+
+
+def get_video_activations(data_loader, key_real, key_fake, trainer,
+                          extractor, sample_size=None):
+    """Video models: shard sequences round-robin by process index, reset
+    the trainer per sequence, run test_single per frame
+    (ref: common.py:79-158)."""
+    dataset = data_loader.dataset
+    num_seq = dataset.num_inference_sequences()
+    indices = list(range(num_seq))[jax.process_index()::jax.process_count()]
+    if sample_size is not None:
+        indices = indices[:sample_size]
+    acts = []
+    for seq_idx in indices:
+        dataset.set_inference_sequence_idx(seq_idx)
+        if trainer is not None:
+            trainer.reset()
+        for data in data_loader:
+            if trainer is None:
+                images = jnp.asarray(np.asarray(data[key_real]))
+            else:
+                out = trainer.test_single(data)
+                images = out["fake_images"]
+            feats = extractor(preprocess_for_inception(images))
+            acts.append(np.asarray(feats))
+    if not acts:
+        return np.zeros((0, 2048), np.float32)
+    return _allgather_if_multihost(np.concatenate(acts, axis=0))

@@ -1,0 +1,81 @@
+"""FID (ref: imaginaire/evaluation/fid.py:16-226).
+
+Per-host activations are gathered (common.py), the master computes
+mean/cov — real stats cached to ``.npz`` next to the data
+(ref: fid.py:102-137) — and the Frechet distance runs on host CPU via
+``scipy.linalg.sqrtm`` (ref: fid.py:178-226).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from imaginaire_tpu.evaluation.common import get_activations, get_video_activations
+from imaginaire_tpu.parallel.mesh import is_master, master_only_print as print  # noqa: A001
+
+
+def activation_stats(acts):
+    mu = np.mean(acts, axis=0)
+    sigma = np.cov(acts, rowvar=False)
+    return mu, sigma
+
+
+def calculate_frechet_distance(mu1, sigma1, mu2, sigma2, eps=1e-6):
+    """||mu1-mu2||^2 + Tr(s1 + s2 - 2 sqrt(s1 s2)) (ref: fid.py:178-226)."""
+    from scipy import linalg
+
+    mu1, mu2 = np.atleast_1d(mu1), np.atleast_1d(mu2)
+    sigma1, sigma2 = np.atleast_2d(sigma1), np.atleast_2d(sigma2)
+    diff = mu1 - mu2
+    covmean, _ = linalg.sqrtm(sigma1.dot(sigma2), disp=False)
+    if not np.isfinite(covmean).all():
+        offset = np.eye(sigma1.shape[0]) * eps
+        covmean = linalg.sqrtm((sigma1 + offset).dot(sigma2 + offset))
+    if np.iscomplexobj(covmean):
+        if not np.allclose(np.diagonal(covmean).imag, 0, atol=1e-3):
+            m = np.max(np.abs(covmean.imag))
+            print(f"FID: imaginary component {m}")
+        covmean = covmean.real
+    return float(diff.dot(diff) + np.trace(sigma1) + np.trace(sigma2)
+                 - 2 * np.trace(covmean))
+
+
+def load_or_compute_stats(path, data_loader, key_real, key_fake, extractor,
+                          generator_fn=None, trainer=None, is_video=False,
+                          sample_size=None, max_batches=None):
+    """Cache-aware stats (ref: fid.py:102-137): fake stats are always
+    recomputed; real stats load from ``path`` when present."""
+    if path and os.path.exists(path) and generator_fn is None and trainer is None:
+        npz = np.load(path)
+        return npz["mu"], npz["sigma"]
+    if is_video:
+        acts = get_video_activations(data_loader, key_real, key_fake,
+                                     trainer, extractor, sample_size)
+    else:
+        acts = get_activations(data_loader, key_real, key_fake, extractor,
+                               generator_fn=generator_fn,
+                               max_batches=max_batches)
+    mu, sigma = activation_stats(acts)
+    if path and generator_fn is None and trainer is None and is_master():
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.savez(path, mu=mu, sigma=sigma)
+        print(f"FID: cached real stats to {path}")
+    return mu, sigma
+
+
+def compute_fid(fid_path, data_loader, extractor, generator_fn,
+                key_real="images", key_fake="fake_images",
+                trainer=None, is_video=False, sample_size=None,
+                max_batches=None):
+    """End-to-end FID (ref: fid.py:16-58). ``fid_path`` holds the cached
+    real-stat ``.npz`` (named after the dataset, ref: fid.py:107-110)."""
+    mu_fake, sigma_fake = load_or_compute_stats(
+        None, data_loader, key_real, key_fake, extractor,
+        generator_fn=generator_fn, trainer=trainer, is_video=is_video,
+        sample_size=sample_size, max_batches=max_batches)
+    mu_real, sigma_real = load_or_compute_stats(
+        fid_path, data_loader, key_real, key_fake, extractor,
+        is_video=False, max_batches=max_batches)
+    return calculate_frechet_distance(mu_fake, sigma_fake, mu_real, sigma_real)

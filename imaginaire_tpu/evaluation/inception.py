@@ -1,0 +1,208 @@
+"""Inception-v3 feature extractor for FID/KID/PRDC.
+
+Flax re-implementation of the torchvision ``inception_v3`` graph the
+reference feeds for metrics (ref: imaginaire/evaluation/fid.py:60-100,
+``inception_v3(pretrained=True)`` with the final fc stripped so forward
+returns the 2048-d pool features; input 299x299, imagenet-normalized —
+ref: evaluation/common.py:44-60).
+
+Layout NHWC, kernels (kh, kw, in, out). BatchNorm runs in inference mode
+with ported running stats (eps 1e-3, torchvision's value).
+
+Weights: convert once from torchvision with
+``scripts/convert_weights.py inception_v3 out.npz`` (needs a machine with
+torchvision; this environment has no egress). ``load_params`` fails
+loudly when the file is missing — metrics against a random-init network
+are meaningless (``random_init=True`` exists for unit tests only).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+BN_EPS = 1e-3
+FEATURE_DIM = 2048
+
+
+class BasicConv(nn.Module):
+    """Conv(bias=False) + frozen BatchNorm + ReLU (torchvision BasicConv2d)."""
+
+    features: int
+    kernel: tuple
+    stride: tuple = (1, 1)
+    padding: tuple = ((0, 0), (0, 0))
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, self.kernel, strides=self.stride,
+                    padding=self.padding, use_bias=False, name="conv")(x)
+        # inference-only BN: running stats are parameters, never updated
+        c = self.features
+        scale = self.param("bn_scale", nn.initializers.ones, (c,))
+        bias = self.param("bn_bias", nn.initializers.zeros, (c,))
+        mean = self.param("bn_mean", nn.initializers.zeros, (c,))
+        var = self.param("bn_var", nn.initializers.ones, (c,))
+        x = (x - mean) * jax.lax.rsqrt(var + BN_EPS) * scale + bias
+        return nn.relu(x)
+
+
+def _avg_pool3(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)),
+                       count_include_pad=False)
+
+
+def _max_pool3s2(x):
+    return nn.max_pool(x, (3, 3), strides=(2, 2))
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = BasicConv(64, (1, 1), name="branch1x1")(x)
+        b5 = BasicConv(48, (1, 1), name="branch5x5_1")(x)
+        b5 = BasicConv(64, (5, 5), padding=((2, 2), (2, 2)), name="branch5x5_2")(b5)
+        b3 = BasicConv(64, (1, 1), name="branch3x3dbl_1")(x)
+        b3 = BasicConv(96, (3, 3), padding=((1, 1), (1, 1)), name="branch3x3dbl_2")(b3)
+        b3 = BasicConv(96, (3, 3), padding=((1, 1), (1, 1)), name="branch3x3dbl_3")(b3)
+        bp = BasicConv(self.pool_features, (1, 1), name="branch_pool")(_avg_pool3(x))
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        b3 = BasicConv(384, (3, 3), stride=(2, 2), name="branch3x3")(x)
+        bd = BasicConv(64, (1, 1), name="branch3x3dbl_1")(x)
+        bd = BasicConv(96, (3, 3), padding=((1, 1), (1, 1)), name="branch3x3dbl_2")(bd)
+        bd = BasicConv(96, (3, 3), stride=(2, 2), name="branch3x3dbl_3")(bd)
+        return jnp.concatenate([b3, bd, _max_pool3s2(x)], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+
+    @nn.compact
+    def __call__(self, x):
+        c7 = self.channels_7x7
+        p17 = ((0, 0), (3, 3))
+        p71 = ((3, 3), (0, 0))
+        b1 = BasicConv(192, (1, 1), name="branch1x1")(x)
+        b7 = BasicConv(c7, (1, 1), name="branch7x7_1")(x)
+        b7 = BasicConv(c7, (1, 7), padding=p17, name="branch7x7_2")(b7)
+        b7 = BasicConv(192, (7, 1), padding=p71, name="branch7x7_3")(b7)
+        bd = BasicConv(c7, (1, 1), name="branch7x7dbl_1")(x)
+        bd = BasicConv(c7, (7, 1), padding=p71, name="branch7x7dbl_2")(bd)
+        bd = BasicConv(c7, (1, 7), padding=p17, name="branch7x7dbl_3")(bd)
+        bd = BasicConv(c7, (7, 1), padding=p71, name="branch7x7dbl_4")(bd)
+        bd = BasicConv(192, (1, 7), padding=p17, name="branch7x7dbl_5")(bd)
+        bp = BasicConv(192, (1, 1), name="branch_pool")(_avg_pool3(x))
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        b3 = BasicConv(192, (1, 1), name="branch3x3_1")(x)
+        b3 = BasicConv(320, (3, 3), stride=(2, 2), name="branch3x3_2")(b3)
+        b7 = BasicConv(192, (1, 1), name="branch7x7x3_1")(x)
+        b7 = BasicConv(192, (1, 7), padding=((0, 0), (3, 3)), name="branch7x7x3_2")(b7)
+        b7 = BasicConv(192, (7, 1), padding=((3, 3), (0, 0)), name="branch7x7x3_3")(b7)
+        b7 = BasicConv(192, (3, 3), stride=(2, 2), name="branch7x7x3_4")(b7)
+        return jnp.concatenate([b3, b7, _max_pool3s2(x)], axis=-1)
+
+
+class InceptionE(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        p13 = ((0, 0), (1, 1))
+        p31 = ((1, 1), (0, 0))
+        b1 = BasicConv(320, (1, 1), name="branch1x1")(x)
+        b3 = BasicConv(384, (1, 1), name="branch3x3_1")(x)
+        b3 = jnp.concatenate([
+            BasicConv(384, (1, 3), padding=p13, name="branch3x3_2a")(b3),
+            BasicConv(384, (3, 1), padding=p31, name="branch3x3_2b")(b3),
+        ], axis=-1)
+        bd = BasicConv(448, (1, 1), name="branch3x3dbl_1")(x)
+        bd = BasicConv(384, (3, 3), padding=((1, 1), (1, 1)), name="branch3x3dbl_2")(bd)
+        bd = jnp.concatenate([
+            BasicConv(384, (1, 3), padding=p13, name="branch3x3dbl_3a")(bd),
+            BasicConv(384, (3, 1), padding=p31, name="branch3x3dbl_3b")(bd),
+        ], axis=-1)
+        bp = BasicConv(192, (1, 1), name="branch_pool")(_avg_pool3(x))
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Returns 2048-d pooled features (fc stripped, ref: fid.py:64-66)."""
+
+    @nn.compact
+    def __call__(self, x):
+        x = BasicConv(32, (3, 3), stride=(2, 2), name="Conv2d_1a_3x3")(x)
+        x = BasicConv(32, (3, 3), name="Conv2d_2a_3x3")(x)
+        x = BasicConv(64, (3, 3), padding=((1, 1), (1, 1)), name="Conv2d_2b_3x3")(x)
+        x = _max_pool3s2(x)
+        x = BasicConv(80, (1, 1), name="Conv2d_3b_1x1")(x)
+        x = BasicConv(192, (3, 3), name="Conv2d_4a_3x3")(x)
+        x = _max_pool3s2(x)
+        x = InceptionA(32, name="Mixed_5b")(x)
+        x = InceptionA(64, name="Mixed_5c")(x)
+        x = InceptionA(64, name="Mixed_5d")(x)
+        x = InceptionB(name="Mixed_6a")(x)
+        x = InceptionC(128, name="Mixed_6b")(x)
+        x = InceptionC(160, name="Mixed_6c")(x)
+        x = InceptionC(160, name="Mixed_6d")(x)
+        x = InceptionC(192, name="Mixed_6e")(x)
+        x = InceptionD(name="Mixed_7a")(x)
+        x = InceptionE(name="Mixed_7b")(x)
+        x = InceptionE(name="Mixed_7c")(x)
+        return jnp.mean(x, axis=(1, 2))  # global avg pool -> (B, 2048)
+
+
+DEFAULT_WEIGHTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "weights", "inception_v3.npz")
+
+
+def load_params(path=None, random_init=False, input_shape=(1, 299, 299, 3)):
+    """Load converted torchvision weights; fail loudly when absent.
+
+    ``random_init=True`` is for unit tests of the metric plumbing only —
+    FID numbers from a random network are meaningless.
+    """
+    path = path or DEFAULT_WEIGHTS
+    if os.path.exists(path):
+        flat = dict(np.load(path))
+        params = {}
+        for k, v in flat.items():
+            node = params
+            parts = k.split("/")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = jnp.asarray(v)
+        return {"params": params}
+    if random_init:
+        return InceptionV3().init(jax.random.PRNGKey(0),
+                                  jnp.zeros(input_shape, jnp.float32))
+    raise FileNotFoundError(
+        f"Inception-v3 weights not found at {path}. Run "
+        "`python scripts/convert_weights.py inception_v3 " + path + "` on a "
+        "machine with torchvision, or pass random_init=True (tests only).")
+
+
+def make_extractor(variables, compute_dtype=jnp.bfloat16):
+    """Jitted (B,299,299,3) imagenet-normalized images -> (B,2048) fp32."""
+    model = InceptionV3()
+
+    @jax.jit
+    def run(images):
+        feats = model.apply(variables, images.astype(compute_dtype))
+        return feats.astype(jnp.float32)
+
+    return run

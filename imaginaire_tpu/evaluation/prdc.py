@@ -1,0 +1,53 @@
+"""PRDC: precision / recall / density / coverage via k-NN radii
+(ref: imaginaire/evaluation/prdc.py:1-127; Naeem et al. 2020).
+
+precision = fraction of fake samples inside ANY real k-NN ball;
+recall    = fraction of real samples inside ANY fake k-NN ball;
+density   = mean count of real balls containing a fake sample / k;
+coverage  = fraction of real balls containing at least one fake sample.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from imaginaire_tpu.evaluation.common import get_activations
+
+
+def _pairwise_distances(a, b):
+    aa = np.sum(a * a, axis=1, keepdims=True)
+    bb = np.sum(b * b, axis=1, keepdims=True)
+    d2 = aa + bb.T - 2 * (a @ b.T)
+    return np.sqrt(np.maximum(d2, 0.0))
+
+
+def _kth_nn_radius(x, k):
+    d = _pairwise_distances(x, x)
+    np.fill_diagonal(d, np.inf)
+    return np.sort(d, axis=1)[:, k - 1]
+
+
+def prdc_from_activations(act_real, act_fake, nearest_k=5):
+    radii_real = _kth_nn_radius(act_real, nearest_k)
+    radii_fake = _kth_nn_radius(act_fake, nearest_k)
+    d_rf = _pairwise_distances(act_real, act_fake)  # (Nr, Nf)
+
+    in_real_ball = d_rf < radii_real[:, None]  # fake j inside real i's ball
+    precision = float(in_real_ball.any(axis=0).mean())
+    recall = float((d_rf < radii_fake[None, :]).any(axis=1).mean())
+    density = float(in_real_ball.sum(axis=0).mean() / nearest_k)
+    coverage = float((d_rf.min(axis=1) < radii_real).mean())
+    return {"precision": precision, "recall": recall,
+            "density": density, "coverage": coverage}
+
+
+def compute_prdc(data_loader, extractor, generator_fn,
+                 key_real="images", key_fake="fake_images",
+                 nearest_k=5, max_batches=None):
+    """(ref: prdc.py:50+)."""
+    act_real = get_activations(data_loader, key_real, key_fake, extractor,
+                               max_batches=max_batches)
+    act_fake = get_activations(data_loader, key_real, key_fake, extractor,
+                               generator_fn=generator_fn,
+                               max_batches=max_batches)
+    return prdc_from_activations(act_real, act_fake, nearest_k)

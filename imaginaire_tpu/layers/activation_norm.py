@@ -50,14 +50,16 @@ class InstanceNorm(nn.Module):
     @nn.compact
     def __call__(self, x, *cond, training=False):
         axes = tuple(range(1, x.ndim - 1))
-        mean = jnp.mean(x, axis=axes, keepdims=True)
-        var = jnp.var(x, axis=axes, keepdims=True)
-        y = (x - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))
+        # statistics in fp32 even under a bf16 compute policy
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=axes, keepdims=True)
+        var = jnp.var(x32, axis=axes, keepdims=True)
+        y = ((x32 - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))).astype(x.dtype)
         if self.affine:
             c = x.shape[-1]
             scale = self.param("scale", nn.initializers.ones, (c,))
             bias = self.param("bias", nn.initializers.zeros, (c,))
-            y = y * scale + bias
+            y = y * scale.astype(y.dtype) + bias.astype(y.dtype)
         return y
 
 
@@ -102,14 +104,15 @@ class LayerNorm2d(nn.Module):
     @nn.compact
     def __call__(self, x, *cond, training=False):
         axes = tuple(range(1, x.ndim))
-        mean = jnp.mean(x, axis=axes, keepdims=True)
-        std = jnp.sqrt(jnp.var(x, axis=axes, keepdims=True) + self.eps)
-        y = (x - mean) / std
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=axes, keepdims=True)
+        std = jnp.sqrt(jnp.var(x32, axis=axes, keepdims=True) + self.eps)
+        y = ((x32 - mean) / std).astype(x.dtype)
         if self.affine:
             c = x.shape[-1]
             gamma = self.param("gamma", nn.initializers.ones, (c,))
             beta = self.param("beta", nn.initializers.zeros, (c,))
-            y = gamma * y + beta
+            y = gamma.astype(y.dtype) * y + beta.astype(y.dtype)
         return y
 
 

@@ -11,10 +11,11 @@ activations; the loss is a pure function of ``(params, inp, target)`` so
 it inlines into the jitted train step (the extractor runs in bf16 on the
 MXU — the analogue of the reference's fp16 eval mode,
 ref: perceptual.py:76-80,110-115). Pretrained torchvision weights are
-loaded via :func:`load_torch_vgg_weights` when a ported ``.npz`` is
-available; otherwise features come from the documented random init (still
-a valid perceptual metric per "randomized features" literature, and
-deterministic given the seed).
+loaded via :func:`load_torch_vgg_weights` from a ported ``.npz``
+(``scripts/convert_weights.py``); ``init_params`` fails loudly when the
+file is missing — training against a random-init VGG silently diverges
+from the reference. ``allow_random_init=True`` is the explicit escape for
+unit tests.
 """
 
 from __future__ import annotations
@@ -139,7 +140,8 @@ class PerceptualLoss:
 
     def __init__(self, network="vgg19", layers="relu_4_1", weights=None,
                  criterion="l1", resize=False, num_scales=1,
-                 instance_normalized=False, compute_dtype=jnp.bfloat16):
+                 instance_normalized=False, compute_dtype=jnp.bfloat16,
+                 weights_path=None, allow_random_init=False):
         if isinstance(layers, str):
             layers = [layers]
         if weights is None:
@@ -163,11 +165,35 @@ class PerceptualLoss:
         self.num_scales = num_scales
         self.instance_normalized = instance_normalized
         self.compute_dtype = compute_dtype
+        self.allow_random_init = allow_random_init
+        if weights_path is None:
+            import os
+
+            weights_path = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))),
+                "weights", f"{network}_features.npz")
+        self.weights_path = weights_path
         self.module = _NETWORKS[network](self.layers)
 
     def init_params(self, key, image_hw=(224, 224)):
-        dummy = jnp.zeros((1, image_hw[0], image_hw[1], 3))
-        return self.module.init(key, dummy)["params"]
+        """Ported torchvision weights, or fail loudly
+        (random init only with explicit ``allow_random_init``)."""
+        import os
+
+        if os.path.exists(self.weights_path):
+            if self.network_name in ("vgg19", "vgg16"):
+                return load_torch_vgg_weights(self.weights_path, self.network_name)
+            return load_torch_alexnet_weights(self.weights_path)
+        if self.allow_random_init:
+            dummy = jnp.zeros((1, image_hw[0], image_hw[1], 3))
+            return self.module.init(key, dummy)["params"]
+        raise FileNotFoundError(
+            f"Pretrained {self.network_name} weights not found at "
+            f"{self.weights_path}. Run `python scripts/convert_weights.py "
+            f"{self.network_name} {self.weights_path}` on a machine with "
+            "torchvision, or set trainer.perceptual_loss.allow_random_init "
+            "(tests only — training quality will not match the reference).")
 
     def __call__(self, params, inp, target):
         inp = apply_imagenet_normalization(inp)
@@ -220,4 +246,20 @@ def load_torch_vgg_weights(npz_path, network="vgg19"):
         }
         conv_k += 1
         torch_i += 2  # conv + relu
+    return params
+
+
+def load_torch_alexnet_weights(npz_path):
+    """torchvision alexnet ``features`` dump -> {'conv_<1..5>': {...}}.
+
+    Sequential layout: conv indices 0, 3, 6, 8, 10 (relu/maxpool between)."""
+    raw = np.load(npz_path)
+    params = {}
+    for k, torch_i in enumerate((0, 3, 6, 8, 10), start=1):
+        w = raw[f"features.{torch_i}.weight"]  # (O, I, kh, kw)
+        b = raw[f"features.{torch_i}.bias"]
+        params[f"conv_{k}"] = {
+            "kernel": jnp.asarray(np.transpose(w, (2, 3, 1, 0))),
+            "bias": jnp.asarray(b),
+        }
     return params

@@ -81,6 +81,12 @@ class BaseTrainer:
         self.clip_grad_norm_G = cfg_get(cfg_get(cfg, "gen_opt", {}), "clip_grad_norm", None)
         self.clip_grad_norm_D = cfg_get(cfg_get(cfg, "dis_opt", {}), "clip_grad_norm", None)
         self.speed_benchmark = cfg_get(tcfg, "speed_benchmark", False)
+        # bf16 compute policy — the XLA-native replacement for apex AMP
+        # (ref: utils/trainer.py:152-154). Master params stay fp32; the
+        # forward/backward runs in compute_dtype (the cast is differentiable,
+        # so grads accumulate back into fp32). bf16 shares fp32's exponent
+        # range, so no loss scaler is needed.
+        self.compute_dtype = jnp.dtype(cfg_get(tcfg, "compute_dtype", "float32"))
 
         # Loss registry (ref: base.py:163-197): subclasses fill weights in
         # _init_loss; loss values come from gen_forward/dis_forward.
@@ -125,6 +131,10 @@ class BaseTrainer:
                                           data, fake_out, training=True))
             state["vars_D"] = vars_D
             state["opt_D"] = self.tx_D.init(vars_D["params"])
+            # Separate D step counter: with cfg.trainer.dis_step > 1 each
+            # sub-step must draw distinct randomness (the G step only
+            # advances 'step' once per iteration).
+            state["step_D"] = jnp.zeros((), jnp.int32)
         if self.model_average:
             state["ema_G"] = ema_init(
                 vars_G["params"], vars_G.get("spectral"),
@@ -160,12 +170,21 @@ class BaseTrainer:
             return diff(net_D_output["fake_outputs"], net_D_output["real_outputs"])
         return net_D_output["fake_outputs"]
 
+    def _to_compute_dtype(self, tree):
+        """Cast fp32 leaves to the compute dtype (identity for fp32 policy)."""
+        if self.compute_dtype == jnp.float32:
+            return tree
+        dt = self.compute_dtype
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(dt)
+            if hasattr(x, "dtype") and x.dtype == jnp.float32 else x, tree)
+
     def _total(self, losses):
         """Weighted sum over registered losses (ref: base.py:698-714)."""
         total = jnp.zeros(())
         for name, w in self.weights.items():
             if name in losses:
-                total = total + losses[name] * w
+                total = total + losses[name].astype(jnp.float32) * w
         return total
 
     # --------------------------------------------------------- jitted steps
@@ -174,9 +193,11 @@ class BaseTrainer:
         rng = jax.random.fold_in(state["rng_G"], state["step"])
 
         def loss_fn(params_G):
-            vars_G = dict(state["vars_G"], params=params_G)
+            vars_G = dict(state["vars_G"], params=self._to_compute_dtype(params_G))
             losses, new_mut = self.gen_forward(
-                vars_G, state.get("vars_D"), state["loss_params"], data, rng)
+                vars_G, self._to_compute_dtype(state.get("vars_D")),
+                state["loss_params"], self._to_compute_dtype(data), rng)
+            losses = {k: v.astype(jnp.float32) for k, v in losses.items()}
             total = self._total(losses)
             return total, (dict(losses, total=total), new_mut)
 
@@ -202,12 +223,14 @@ class BaseTrainer:
         return state, losses
 
     def _dis_step_fn(self, state, data):
-        rng = jax.random.fold_in(state["rng_D"], state["step"])
+        rng = jax.random.fold_in(state["rng_D"], state["step_D"])
 
         def loss_fn(params_D):
-            vars_D = dict(state["vars_D"], params=params_D)
+            vars_D = dict(state["vars_D"], params=self._to_compute_dtype(params_D))
             losses, new_mut = self.dis_forward(
-                state["vars_G"], vars_D, state["loss_params"], data, rng)
+                self._to_compute_dtype(state["vars_G"]), vars_D,
+                state["loss_params"], self._to_compute_dtype(data), rng)
+            losses = {k: v.astype(jnp.float32) for k, v in losses.items()}
             total = self._total(losses)
             return total, (dict(losses, total=total), new_mut)
 
@@ -219,7 +242,7 @@ class BaseTrainer:
             grads, state["opt_D"], state["vars_D"]["params"])
         new_params = optax.apply_updates(state["vars_D"]["params"], updates)
         state = dict(state, vars_D=dict(state["vars_D"], params=new_params, **new_mut),
-                     opt_D=new_opt)
+                     opt_D=new_opt, step_D=state["step_D"] + 1)
         return state, losses
 
     # ------------------------------------------------------------ lifecycle
@@ -304,9 +327,13 @@ class BaseTrainer:
         return None
 
     def write_metrics(self):
+        """FID + best-FID tracking (ref: base.py:467-479)."""
         fid = self._compute_fid()
         if fid is not None:
+            if getattr(self, "best_fid", None) is None or fid < self.best_fid:
+                self.best_fid = fid
             self._meter("FID").write(float(fid))
+            self._meter("best_FID").write(float(self.best_fid))
             self._flush_meters(self.current_iteration)
 
     # --------------------------------------------------------- persistence
@@ -340,10 +367,14 @@ class BaseTrainer:
             self.state = restored
             self.current_epoch = int(payload["meta"]["epoch"])
             self.current_iteration = int(payload["meta"]["iteration"])
+        elif self.state is None:
+            # weights-only load before init_state: adopt the restored
+            # state wholesale (counters stay at 0).
+            self.state = restored
         else:
             # weights only
             self.state["vars_G"] = restored["vars_G"]
-            if "vars_D" in restored and self.state is not None and "vars_D" in self.state:
+            if "vars_D" in restored and "vars_D" in self.state:
                 self.state["vars_D"] = restored["vars_D"]
             if "ema_G" in restored:
                 self.state["ema_G"] = restored["ema_G"]

@@ -45,7 +45,9 @@ class Trainer(BaseTrainer):
             p = tcfg.perceptual_loss
             self.perceptual = PerceptualLoss(
                 network=p.mode, layers=list(p.layers),
-                weights=list(cfg_get(p, "weights", None) or []) or None)
+                weights=list(cfg_get(p, "weights", None) or []) or None,
+                weights_path=cfg_get(p, "weights_path", None),
+                allow_random_init=cfg_get(p, "allow_random_init", False))
             self.weights["Perceptual"] = tcfg.loss_weight.perceptual
 
     def init_loss_params(self, key):
@@ -61,7 +63,10 @@ class Trainer(BaseTrainer):
             rngs={"noise": rng}, mutable=list(MUTABLE))
         return out, new_mut
 
-    def _apply_D(self, vars_D, data, net_G_output, training):
+    def _apply_D(self, vars_D, data, net_G_output, training, mutable=False):
+        if mutable:
+            return self.net_D.apply(vars_D, data, net_G_output,
+                                    training=training, mutable=list(MUTABLE))
         return self.net_D.apply(vars_D, data, net_G_output, training=training)
 
     def gen_forward(self, vars_G, vars_D, loss_params, data, rng, training=True):
@@ -90,7 +95,11 @@ class Trainer(BaseTrainer):
         net_G_output, _ = self._apply_G(vars_G, data, rng, training)
         net_G_output = jax.lax.stop_gradient(
             {"fake_images": net_G_output["fake_images"]})
-        net_D_output = self._apply_D(vars_D, data, net_G_output, training)
+        # D runs with mutable spectral/batch_stats so the power-iteration
+        # vector u advances every dis step (torch spectral_norm updates
+        # weight_u on every training forward, ref: layers/weight_norm.py).
+        net_D_output, new_mut_D = self._apply_D(
+            vars_D, data, net_G_output, training, mutable=True)
 
         fake_loss = gan_loss(self._get_outputs(net_D_output, real=False),
                              False, self.gan_mode, dis_update=True)
@@ -98,7 +107,7 @@ class Trainer(BaseTrainer):
                              True, self.gan_mode, dis_update=True)
         losses = {"GAN/fake": fake_loss, "GAN/true": true_loss,
                   "GAN": fake_loss + true_loss}
-        return losses, {}
+        return losses, new_mut_D
 
     # ---------------------------------------------------------- data hooks
 
@@ -136,6 +145,57 @@ class Trainer(BaseTrainer):
                 if (h2, w2) != (h, w):
                     out[key] = arr[:, :h2, :w2]
         return out
+
+    # ------------------------------------------------------------------ FID
+
+    def _fid_extractor(self):
+        if getattr(self, "_cached_fid_extractor", None) is None:
+            from imaginaire_tpu.evaluation import inception
+
+            variables = inception.load_params(
+                random_init=cfg_get(cfg_get(self.cfg, "trainer", {}),
+                                    "fid_random_init", False))
+            self._cached_fid_extractor = inception.make_extractor(variables)
+        return self._cached_fid_extractor
+
+    def _compute_fid(self):
+        """FID for the regular and (if enabled) EMA generator
+        (ref: trainers/spade.py:264-295)."""
+        if self.val_data_loader is None:
+            return None
+        import os
+
+        from imaginaire_tpu.evaluation import compute_fid
+
+        try:
+            extractor = self._fid_extractor()
+        except FileNotFoundError as e:
+            print(f"FID skipped: {e}")
+            return None
+
+        logdir = cfg_get(self.cfg, "logdir", ".")
+        data_name = cfg_get(cfg_get(self.cfg, "data", {}), "name", "data")
+        fid_path = os.path.join(logdir, f"real_stats_{data_name}.npz")
+
+        def make_gen_fn(variables):
+            def gen_fn(data):
+                # side-effect-free preprocessing (start_of_iteration would
+                # clobber current_iteration/timers mid-write_metrics)
+                data = jax.tree_util.tree_map(
+                    jnp.asarray, self._start_of_iteration(data, -1))
+                out, _ = self._apply_G(variables, data, jax.random.PRNGKey(0),
+                                       training=False)
+                return out["fake_images"]
+            return gen_fn
+
+        fid = compute_fid(fid_path, self.val_data_loader, extractor,
+                          make_gen_fn(self.state["vars_G"]))
+        if self.model_average:
+            ema_vars = dict(self.state["vars_G"], params=self.state["ema_G"])
+            fid_ema = compute_fid(fid_path, self.val_data_loader, extractor,
+                                  make_gen_fn(ema_vars))
+            self._meter("FID_ema").write(float(fid_ema))
+        return fid
 
     def _get_visualizations(self, data):
         """(input, label-viz, fake, [ema-fake]) strip

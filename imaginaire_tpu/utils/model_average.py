@@ -53,9 +53,15 @@ def collapse_spectral_norm(params, spectral):
 
 
 def ema_init(params, spectral=None, remove_sn=True):
-    """Initialize the averaged copy (ref: model_average.py:48-81)."""
+    """Initialize the averaged copy (ref: model_average.py:48-81).
+
+    Every leaf is a fresh buffer: leaves that pass through
+    ``collapse_spectral_norm`` unchanged must NOT alias ``params``, or a
+    jitted step that donates the state pytree would donate the same buffer
+    twice and crash.
+    """
     src = collapse_spectral_norm(params, spectral) if remove_sn else params
-    return jax.tree_util.tree_map(jnp.asarray, src)
+    return jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), src)
 
 
 def ema_update(avg_params, params, num_updates, beta=0.9999,

@@ -66,7 +66,7 @@ def tensor2flow(flow):
     t = hsv[..., 2] * (1 - (1 - f) * hsv[..., 1])
     vch = hsv[..., 2]
     rgb = np.select(
-        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [(i == k)[..., None] for k in range(6)],
         [np.stack([vch, t, p], -1), np.stack([q, vch, p], -1),
          np.stack([p, vch, t], -1), np.stack([p, q, vch], -1),
          np.stack([t, p, vch], -1), np.stack([vch, p, q], -1)])

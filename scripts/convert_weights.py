@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Convert torchvision pretrained weights to the .npz formats this
+framework loads. Must run on a machine WITH torchvision + network access
+(this repo's runtime environment has neither); the output .npz is then
+dropped into ``weights/``.
+
+Usage:
+    python scripts/convert_weights.py vgg19 weights/vgg19_features.npz
+    python scripts/convert_weights.py vgg16 weights/vgg16_features.npz
+    python scripts/convert_weights.py alexnet weights/alexnet_features.npz
+    python scripts/convert_weights.py inception_v3 weights/inception_v3.npz
+
+Formats:
+  - vgg19/vgg16/alexnet: the torchvision ``<net>.features`` state dict,
+    flat npz with keys ``features.<i>.weight`` / ``features.<i>.bias``
+    (OIHW kept as-is; imaginaire_tpu.losses.perceptual.load_torch_vgg_weights
+    does the HWIO transpose at load).
+  - inception_v3: flax-tree paths joined by '/', kernels already HWIO,
+    BN folded as bn_scale/bn_bias/bn_mean/bn_var — exactly the tree
+    imaginaire_tpu.evaluation.inception.load_params rebuilds.
+
+Consumers: losses/perceptual.py (VGG), evaluation/inception.py (FID),
+mirroring the reference's torchvision downloads
+(ref: imaginaire/losses/perceptual.py:175-358, evaluation/fid.py:60-100).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def convert_features(net_name, out_path):
+    import torchvision
+
+    net = getattr(torchvision.models, net_name)(pretrained=True).eval()
+    flat = {k: v.detach().cpu().numpy()
+            for k, v in net.state_dict().items() if k.startswith("features.")}
+    np.savez(out_path, **flat)
+    print(f"wrote {len(flat)} arrays to {out_path}")
+
+
+def convert_inception(out_path):
+    import torchvision
+
+    net = torchvision.models.inception_v3(
+        pretrained=True, transform_input=False, aux_logits=True).eval()
+    sd = {k: v.detach().cpu().numpy() for k, v in net.state_dict().items()}
+    flat = {}
+    for k, v in sd.items():
+        if k.startswith("AuxLogits.") or k.startswith("fc."):
+            continue  # fc stripped (ref: evaluation/fid.py:64-66)
+        if k.endswith("num_batches_tracked"):
+            continue
+        parts = k.split(".")
+        # <block>[.<branch>].conv.weight | .bn.{weight,bias,running_mean,running_var}
+        if parts[-2] == "conv" and parts[-1] == "weight":
+            path = "/".join(parts[:-2] + ["conv", "kernel"])
+            flat[path] = np.transpose(v, (2, 3, 1, 0))  # OIHW -> HWIO
+        elif parts[-2] == "bn":
+            suffix = {"weight": "bn_scale", "bias": "bn_bias",
+                      "running_mean": "bn_mean", "running_var": "bn_var"}[parts[-1]]
+            flat["/".join(parts[:-2] + [suffix])] = v
+        else:
+            raise ValueError(f"unexpected key {k}")
+    np.savez(out_path, **flat)
+    print(f"wrote {len(flat)} arrays to {out_path}")
+
+
+def main():
+    if len(sys.argv) != 3:
+        print(__doc__)
+        raise SystemExit(1)
+    name, out = sys.argv[1], sys.argv[2]
+    if name == "inception_v3":
+        convert_inception(out)
+    elif name in ("vgg19", "vgg16", "alexnet"):
+        convert_features(name, out)
+    else:
+        raise SystemExit(f"unknown network {name}")
+
+
+if __name__ == "__main__":
+    main()
