@@ -1,0 +1,1 @@
+"""Model-specific utilities (ref: imaginaire/model_utils/)."""

@@ -1,0 +1,160 @@
+"""pix2pixHD model utilities (ref: imaginaire/model_utils/pix2pixHD.py).
+
+TPU-first redesigns:
+  - Instance-wise average pooling (ref: generators/pix2pixHD.py:277-360,
+    a host Python loop over ``np.unique``) becomes a jittable
+    segment-mean: ``jnp.unique(size=K)`` + ``segment_sum`` + gather,
+    vmapped over the batch. One XLA program, no host sync.
+  - ``get_edges`` (ref: model_utils/pix2pixHD.py:137-154) is pure jnp
+    shifts/compares.
+  - K-means feature clustering (ref: model_utils/pix2pixHD.py:17-136)
+    stays host-side (sklearn) — it runs once per checkpoint; the
+    per-instance representative feature is the instance mean, which the
+    pooled encoder output already holds at every instance pixel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_PAD_ID = 2 ** 30  # sorts after any real instance id
+
+
+def instance_average(features, instance_map, max_instances=64):
+    """Replace each pixel's feature with its instance's mean feature.
+
+    features: (B, H, W, C); instance_map: (B, H, W) or (B, H, W, 1) with
+    integer-valued ids (any range, e.g. Cityscapes 26001+).
+    ``max_instances`` bounds the number of distinct ids per image
+    (static for XLA); extra ids share the overflow segment.
+    """
+    if instance_map.ndim == 4:
+        instance_map = instance_map[..., 0]
+    inst = instance_map.astype(jnp.int32)
+
+    def one(f, ids):
+        flat_ids = ids.reshape(-1)
+        f_flat = f.reshape(-1, f.shape[-1])
+        uniq = jnp.unique(flat_ids, size=max_instances, fill_value=_PAD_ID)
+        seg = jnp.clip(jnp.searchsorted(uniq, flat_ids), 0, max_instances - 1)
+        sums = jax.ops.segment_sum(f_flat, seg, num_segments=max_instances)
+        cnts = jax.ops.segment_sum(jnp.ones_like(flat_ids, f.dtype), seg,
+                                   num_segments=max_instances)
+        means = sums / jnp.maximum(cnts, 1.0)[:, None]
+        return means[seg].reshape(f.shape)
+
+    return jax.vmap(one)(features, inst)
+
+
+def get_edges(instance_map):
+    """Instance-boundary map (ref: model_utils/pix2pixHD.py:137-154).
+
+    instance_map: (B, H, W, 1); returns float (B, H, W, 1) with 1.0 at
+    pixels whose horizontal or vertical neighbor has a different id.
+    """
+    t = instance_map
+    dw = t[:, :, 1:] != t[:, :, :-1]
+    dh = t[:, 1:, :] != t[:, :-1, :]
+    edge = jnp.zeros(t.shape, bool)
+    edge = edge.at[:, :, 1:].set(dw)
+    edge = edge.at[:, :, :-1].set(edge[:, :, :-1] | dw)
+    edge = edge.at[:, 1:, :].set(edge[:, 1:, :] | dh)
+    edge = edge.at[:, :-1, :].set(edge[:, :-1, :] | dh)
+    return edge.astype(jnp.float32)
+
+
+def instance_labels(instance_ids, is_cityscapes=True):
+    """Map raw instance ids to semantic label ids
+    (Cityscapes packs them as label*1000+k, ref: model_utils/pix2pixHD.py:115-118)."""
+    ids = np.asarray(instance_ids, np.int64)
+    if is_cityscapes:
+        return np.where(ids >= 1000, ids // 1000, ids)
+    return ids
+
+
+def collect_instance_features(feat_map, instance_map, label_nc,
+                              is_cityscapes=True):
+    """Per-instance (feature, area-proportion) rows grouped by label
+    (ref: model_utils/pix2pixHD.py:74-136). Host-side numpy.
+
+    feat_map: (B, H, W, C) instance-pooled encoder output;
+    instance_map: (B, H, W, 1) raw ids. Returns {label: (N, C+1) array}.
+    """
+    feat_map = np.asarray(feat_map)
+    instance_map = np.asarray(instance_map)
+    b, h, w, c = feat_map.shape
+    out = {label: [] for label in range(label_nc)}
+    for n in range(b):
+        inst = instance_map[n, ..., 0].astype(np.int64)
+        for i in np.unique(inst):
+            label = int(instance_labels(i, is_cityscapes))
+            if not 0 <= label < label_nc:
+                continue
+            mask = inst == i
+            # pooled map is constant within the instance -> any pixel works
+            ys, xs = np.nonzero(mask)
+            feat = feat_map[n, ys[0], xs[0]]
+            row = np.concatenate([feat, [mask.sum() / (h * w)]])
+            out[label].append(row)
+    return {k: np.stack(v) if v else np.zeros((0, c + 1), np.float32)
+            for k, v in out.items()}
+
+
+def cluster_features(encode_fn, data_loader, label_nc, feat_nc,
+                     n_clusters=10, small_ratio=0.0625, is_cityscapes=True,
+                     max_batches=None):
+    """K-means over instance features (ref: model_utils/pix2pixHD.py:17-71).
+
+    encode_fn: data -> (B, H, W, feat_nc) pooled features (jit-compiled
+    encoder apply). Returns (label_nc, n_clusters, feat_nc) float32 with
+    zero rows for labels lacking instances.
+    """
+    from sklearn.cluster import KMeans
+
+    per_label = {label: [] for label in range(label_nc)}
+    for it, data in enumerate(data_loader):
+        if max_batches is not None and it >= max_batches:
+            break
+        feats = collect_instance_features(
+            encode_fn(data), data["instance_maps"], label_nc, is_cityscapes)
+        for label, rows in feats.items():
+            if rows.size:
+                per_label[label].append(rows)
+    centers = np.zeros((label_nc, n_clusters, feat_nc), np.float32)
+    for label in range(label_nc):
+        if not per_label[label]:
+            continue
+        rows = np.concatenate(per_label[label], axis=0)
+        rows = rows[rows[:, -1] > small_ratio, :-1]
+        if not rows.shape[0]:
+            continue
+        k = min(rows.shape[0], n_clusters)
+        km = KMeans(n_clusters=k, random_state=0, n_init=10).fit(rows)
+        centers[label, :k] = km.cluster_centers_
+    return centers
+
+
+def sample_feature_map(cluster_centers, instance_map, key,
+                       is_cityscapes=True):
+    """Multi-modal inference: per instance, pick a random cluster center
+    of its label and paint it over the instance region (host-side;
+    ref inference path of generators/pix2pixHD.py Encoder buffers)."""
+    centers = np.asarray(cluster_centers)
+    label_nc, n_clusters, feat_nc = centers.shape
+    inst_np = np.asarray(instance_map)
+    b, h, w, _ = inst_np.shape
+    rng = np.random.RandomState(int(jax.random.randint(key, (), 0, 2 ** 31 - 1)))
+    out = np.zeros((b, h, w, feat_nc), np.float32)
+    for n in range(b):
+        inst = inst_np[n, ..., 0].astype(np.int64)
+        for i in np.unique(inst):
+            label = int(instance_labels(i, is_cityscapes))
+            if not 0 <= label < label_nc:
+                continue
+            valid = np.nonzero(np.abs(centers[label]).sum(axis=1) > 0)[0]
+            if valid.size == 0:
+                continue
+            out[n][inst == i] = centers[label, rng.choice(valid)]
+    return jnp.asarray(out)

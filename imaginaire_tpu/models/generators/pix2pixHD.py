@@ -1,0 +1,273 @@
+"""pix2pixHD coarse-to-fine generator
+(ref: imaginaire/generators/pix2pixHD.py:18-349).
+
+Architecture: a GlobalGenerator (conv7 -> stride-2 downsample ladder ->
+'CNACN' residual trunk -> nearest-upsample ladder -> conv7+tanh) plus an
+optional pyramid of LocalEnhancers that refine at 2x resolution each
+(ref: pix2pixHD.py:164-221, 224-275), and an instance-wise Encoder whose
+pooled features enable multi-modal synthesis (ref: pix2pixHD.py:277-360).
+
+TPU-first: the enhancer pyramid is a static unrolled ladder (one XLA
+program); instance pooling is the jittable segment-mean from
+model_utils/pix2pixHD.instance_average instead of the reference's host
+loop over np.unique.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from imaginaire_tpu.config import as_attrdict, cfg_get
+from imaginaire_tpu.layers import Conv2dBlock, Res2dBlock
+from imaginaire_tpu.model_utils.pix2pixHD import instance_average
+from imaginaire_tpu.utils.data import (
+    get_paired_input_image_channel_number,
+    get_paired_input_label_channel_number,
+)
+
+
+def _upsample2x(x):
+    b, h, w, c = x.shape
+    return jax.image.resize(x, (b, 2 * h, 2 * w, c), method="nearest")
+
+
+def _downsample2x_avg(x):
+    """AvgPool(3, stride 2, pad 1, count_include_pad=False)
+    (ref: pix2pixHD.py:97-98)."""
+    return nn.avg_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)),
+                       count_include_pad=False)
+
+
+class GlobalGenerator(nn.Module):
+    """Coarse generator (ref: pix2pixHD.py:224-275). ``output_img=False``
+    stops before the final conv7+tanh (its feature output feeds the first
+    LocalEnhancer, ref: pix2pixHD.py:78-85)."""
+
+    num_filters: int = 64
+    num_downsamples: int = 4
+    num_res_blocks: int = 9
+    num_img_channels: int = 3
+    padding_mode: str = "reflect"
+    weight_norm_type: str = ""
+    activation_norm_type: str = "instance"
+    activation_norm_params: Optional[Any] = None
+    output_img: bool = True
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        common = dict(padding_mode=self.padding_mode,
+                      weight_norm_type=self.weight_norm_type,
+                      activation_norm_type=self.activation_norm_type,
+                      activation_norm_params=self.activation_norm_params,
+                      nonlinearity="relu")
+        x = Conv2dBlock(self.num_filters, 7, padding=3, name="conv_in",
+                        **common)(x, training=training)
+        for i in range(self.num_downsamples):
+            ch = self.num_filters * (2 ** i)
+            x = Conv2dBlock(ch * 2, 3, stride=2, padding=1,
+                            name=f"down_{i}", **common)(x, training=training)
+        ch = self.num_filters * (2 ** self.num_downsamples)
+        for i in range(self.num_res_blocks):
+            x = Res2dBlock(ch, 3, padding=1, order="CNACN",
+                           padding_mode=self.padding_mode,
+                           weight_norm_type=self.weight_norm_type,
+                           activation_norm_type=self.activation_norm_type,
+                           activation_norm_params=self.activation_norm_params,
+                           nonlinearity="relu",
+                           name=f"res_{i}")(x, training=training)
+        for i in reversed(range(self.num_downsamples)):
+            ch = self.num_filters * (2 ** i)
+            x = _upsample2x(x)
+            x = Conv2dBlock(ch, 3, padding=1, name=f"up_{i}",
+                            **common)(x, training=training)
+        if self.output_img:
+            x = Conv2dBlock(self.num_img_channels, 7, padding=3,
+                            padding_mode=self.padding_mode,
+                            nonlinearity="tanh",
+                            name="conv_out")(x, training=training)
+        return x
+
+
+class LocalEnhancer(nn.Module):
+    """High-res refinement stage (ref: pix2pixHD.py:164-221): downsample
+    the fine input, add the coarse output, res blocks, upsample; the last
+    enhancer emits the image."""
+
+    num_filters: int
+    num_res_blocks: int = 3
+    num_img_channels: int = 3
+    padding_mode: str = "reflect"
+    weight_norm_type: str = ""
+    activation_norm_type: str = "instance"
+    activation_norm_params: Optional[Any] = None
+    output_img: bool = False
+
+    @nn.compact
+    def __call__(self, output_coarse, input_fine, training=False):
+        common = dict(padding_mode=self.padding_mode,
+                      weight_norm_type=self.weight_norm_type,
+                      activation_norm_type=self.activation_norm_type,
+                      activation_norm_params=self.activation_norm_params,
+                      nonlinearity="relu")
+        x = Conv2dBlock(self.num_filters, 7, padding=3, name="down_0",
+                        **common)(input_fine, training=training)
+        x = Conv2dBlock(self.num_filters * 2, 3, stride=2, padding=1,
+                        name="down_1", **common)(x, training=training)
+        x = x + output_coarse
+        for i in range(self.num_res_blocks):
+            x = Res2dBlock(self.num_filters * 2, 3, padding=1, order="CNACN",
+                           padding_mode=self.padding_mode,
+                           weight_norm_type=self.weight_norm_type,
+                           activation_norm_type=self.activation_norm_type,
+                           activation_norm_params=self.activation_norm_params,
+                           nonlinearity="relu",
+                           name=f"res_{i}")(x, training=training)
+        x = _upsample2x(x)
+        x = Conv2dBlock(self.num_filters, 3, padding=1, name="up_0",
+                        **common)(x, training=training)
+        if self.output_img:
+            x = Conv2dBlock(self.num_img_channels, 7, padding=3,
+                            padding_mode=self.padding_mode,
+                            nonlinearity="tanh",
+                            name="conv_out")(x, training=training)
+        return x
+
+
+class Encoder(nn.Module):
+    """Instance-feature encoder (ref: pix2pixHD.py:277-360): conv
+    autoencoder over the real image, then instance-wise average pooling
+    (segment-mean, jit-safe)."""
+
+    num_feat_channels: int = 3
+    num_filters: int = 16
+    num_downsamples: int = 4
+    padding_mode: str = "reflect"
+    weight_norm_type: str = "none"
+    activation_norm_type: str = "instance"
+    max_instances: int = 64
+
+    @nn.compact
+    def __call__(self, images, instance_map, training=False):
+        common = dict(padding_mode=self.padding_mode,
+                      weight_norm_type=self.weight_norm_type,
+                      activation_norm_type=self.activation_norm_type,
+                      nonlinearity="relu")
+        x = Conv2dBlock(self.num_filters, 7, padding=3, name="conv_in",
+                        **common)(images, training=training)
+        for i in range(self.num_downsamples):
+            ch = self.num_filters * (2 ** i)
+            x = Conv2dBlock(ch * 2, 3, stride=2, padding=1,
+                            name=f"down_{i}", **common)(x, training=training)
+        for i in reversed(range(self.num_downsamples)):
+            ch = self.num_filters * (2 ** i)
+            x = _upsample2x(x)
+            x = Conv2dBlock(ch, 3, padding=1, name=f"up_{i}",
+                            **common)(x, training=training)
+        x = Conv2dBlock(self.num_feat_channels, 7, padding=3,
+                        padding_mode=self.padding_mode, nonlinearity="tanh",
+                        name="conv_out")(x, training=training)
+        return instance_average(x, instance_map,
+                                max_instances=self.max_instances)
+
+
+class Generator(nn.Module):
+    """Full pix2pixHD generator (ref: pix2pixHD.py:18-161).
+
+    data keys: 'label' (one-hot seg + edge channels), optionally
+    'instance_maps' (raw ids) when the config lists instance_maps in
+    input_labels; 'feature_maps' may be passed directly (inference with
+    pre-sampled cluster features).
+    """
+
+    gen_cfg: Any
+    data_cfg: Any
+
+    def setup(self):
+        gen_cfg = as_attrdict(self.gen_cfg)
+        data_cfg = as_attrdict(self.data_cfg)
+        g = cfg_get(gen_cfg, "global_generator", None) or {}
+        le = cfg_get(gen_cfg, "local_enhancer", None) or {}
+        self.num_enhancers = cfg_get(le, "num_enhancers", 1)
+        nf_global = cfg_get(g, "num_filters", 64)
+        self.padding_mode = cfg_get(gen_cfg, "padding_mode", "reflect")
+        wn = cfg_get(gen_cfg, "weight_norm_type", "")
+        an = cfg_get(gen_cfg, "activation_norm_type", "instance")
+        anp = cfg_get(gen_cfg, "activation_norm_params", None)
+        num_img = get_paired_input_image_channel_number(data_cfg)
+        num_in = get_paired_input_label_channel_number(data_cfg)
+
+        input_labels = list(cfg_get(data_cfg, "input_labels", []) or [])
+        self.contain_instance_map = bool(input_labels) and \
+            input_labels[-1] == "instance_maps"
+        enc_cfg = cfg_get(gen_cfg, "enc", None)
+        self.concat_features = False
+        if enc_cfg is not None and self.contain_instance_map:
+            feat_nc = cfg_get(enc_cfg, "num_feat_channels", 0)
+            if feat_nc > 0:
+                self.concat_features = True
+                self.encoder = Encoder(
+                    num_feat_channels=feat_nc,
+                    num_filters=cfg_get(enc_cfg, "num_filters", 16),
+                    num_downsamples=cfg_get(enc_cfg, "num_downsamples", 4),
+                    padding_mode=cfg_get(enc_cfg, "padding_mode", "reflect"),
+                    weight_norm_type=cfg_get(enc_cfg, "weight_norm_type", "none"),
+                    activation_norm_type=cfg_get(
+                        enc_cfg, "activation_norm_type", "instance"),
+                    max_instances=cfg_get(enc_cfg, "max_instances", 64),
+                    name="encoder")
+
+        self.global_model = GlobalGenerator(
+            num_filters=nf_global,
+            num_downsamples=cfg_get(g, "num_downsamples", 4),
+            num_res_blocks=cfg_get(g, "num_res_blocks", 9),
+            num_img_channels=num_img,
+            padding_mode=self.padding_mode,
+            weight_norm_type=wn,
+            activation_norm_type=an,
+            activation_norm_params=anp,
+            output_img=(self.num_enhancers == 0),
+            name="global")
+        enhancers = []
+        for n in range(self.num_enhancers):
+            enhancers.append(LocalEnhancer(
+                num_filters=nf_global // (2 ** (n + 1)),
+                num_res_blocks=cfg_get(le, "num_res_blocks", 3),
+                num_img_channels=num_img,
+                padding_mode=self.padding_mode,
+                weight_norm_type=wn,
+                activation_norm_type=an,
+                activation_norm_params=anp,
+                output_img=(n == self.num_enhancers - 1),
+                name=f"enhancer_{n}"))
+        self.enhancers = enhancers
+
+    def __call__(self, data, training=False, random_style=False):
+        label = data["label"]
+        output = {}
+        if self.concat_features:
+            if data.get("feature_maps") is not None:
+                features = data["feature_maps"]
+            else:
+                features = self.encoder(data["images"], data["instance_maps"],
+                                        training=training)
+            label = jnp.concatenate([label, features.astype(label.dtype)],
+                                    axis=-1)
+            output["feature_maps"] = features
+
+        pyramid = [label]
+        for _ in range(self.num_enhancers):
+            pyramid.append(_downsample2x_avg(pyramid[-1]))
+        x = self.global_model(pyramid[-1], training=training)
+        for n, enhancer in enumerate(self.enhancers):
+            x = enhancer(x, pyramid[self.num_enhancers - n - 1],
+                         training=training)
+        output["fake_images"] = x
+        return output
+
+    def inference(self, data, **kwargs):
+        """(ref: pix2pixHD.py:152-161)."""
+        return self(data, training=False)["fake_images"]

@@ -111,8 +111,14 @@ class BaseTrainer:
         """Parameters of loss networks (e.g. VGG); frozen, stored in state."""
         return {}
 
+    def _init_data(self, data):
+        """Hook: device-side data prep applied before module init (e.g.
+        int-label one-hot expansion). Default: identity."""
+        return data
+
     def init_state(self, key, data):
         """Build the full train-state pytree from one example batch."""
+        data = self._init_data(data)
         k_g, k_d, k_loss, k_noise, k_rg, k_rd = jax.random.split(key, 6)
         vars_G = self.net_G.init({"params": k_g, "noise": k_noise},
                                  data, training=True)
@@ -428,9 +434,10 @@ class BaseTrainer:
         return self.meters[name]
 
     def _log_losses(self, update_type, losses):
+        # values stay on device; Meter.flush materializes them at
+        # logging_iter so the step loop never blocks on a host sync.
         for name, value in losses.items():
-            self._meter(f"{update_type}/{name}").write(
-                float(jax.device_get(value)))
+            self._meter(f"{update_type}/{name}").write(value)
 
     def _flush_meters(self, step):
         for meter in self.meters.values():

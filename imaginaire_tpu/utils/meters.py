@@ -40,7 +40,14 @@ def write_summary(name, data, step, hist=False):
 
 
 class Meter:
-    """(ref: meters.py:107-159)."""
+    """(ref: meters.py:107-159).
+
+    ``write`` accepts plain floats OR device arrays; device values are
+    kept as-is and only materialized at ``flush`` time. This keeps the
+    training loop free of per-step host syncs (a device_get per loss per
+    step would serialize XLA dispatch — the TPU analogue of the
+    reference detaching losses post-step, ref: base.py:716-721).
+    """
 
     def __init__(self, name):
         self.name = name
@@ -51,16 +58,17 @@ class Meter:
 
     def write(self, value):
         if value is not None:
-            self.values.append(float(value))
+            self.values.append(value)
 
     def write_image(self, img_grid, step):
         if is_master() and _WRITER is not None:
             _WRITER.add_image(self.name, img_grid, step, dataformats="HWC")
 
     def flush(self, step):
-        values = [v for v in self.values if math.isfinite(v)]
-        if len(values) != len(self.values):
+        values = [float(v) for v in self.values]  # device sync happens here
+        finite = [v for v in values if math.isfinite(v)]
+        if len(finite) != len(values):
             print(f"meter {self.name} has non-finite values")
-        if values:
-            write_summary(self.name, sum(values) / len(values), step)
+        if finite:
+            write_summary(self.name, sum(finite) / len(finite), step)
         self.reset()

@@ -128,6 +128,25 @@ class TestSPADETraining:
         assert {"GAN", "FeatureMatching", "GaussianKL", "Perceptual", "total"} <= set(
             losses_hist[0][1].keys())
 
+    def test_int_label_on_device_onehot(self, rng, tmp_path):
+        """(B,H,W) int label maps are one-hot expanded inside the jitted
+        step — the TPU-idiomatic H2D path (ships KBs, not one-hot MBs)."""
+        cfg = Config(CFG_PATH)
+        cfg.logdir = str(tmp_path)
+        from imaginaire_tpu.registry import resolve
+
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        data = {
+            "images": jnp.asarray(rng.rand(1, 256, 256, 3).astype(np.float32)) * 2 - 1,
+            "label": jnp.asarray(rng.randint(0, 14, (1, 256, 256)).astype(np.int32)),
+        }
+        trainer.init_state(jax.random.PRNGKey(0), data)
+        batch = trainer.start_of_iteration(data, 1)
+        d = trainer.dis_update(batch)
+        g = trainer.gen_update(batch)
+        for name, v in {**d, **g}.items():
+            assert np.isfinite(float(jax.device_get(v))), name
+
     def test_bf16_policy_parity(self, rng, tmp_path):
         """bf16 compute policy: losses must stay close to fp32 and params
         must remain fp32 masters (the AMP replacement, SURVEY §2.2)."""
