@@ -39,9 +39,12 @@ def instance_average(features, instance_map, max_instances=64):
         f_flat = f.reshape(-1, f.shape[-1])
         uniq = jnp.unique(flat_ids, size=max_instances, fill_value=_PAD_ID)
         seg = jnp.clip(jnp.searchsorted(uniq, flat_ids), 0, max_instances - 1)
-        sums = jax.ops.segment_sum(f_flat, seg, num_segments=max_instances)
+        # ids beyond the kept set go to a dedicated overflow segment —
+        # not into the largest real instance's mean.
+        seg = jnp.where(uniq[seg] == flat_ids, seg, max_instances)
+        sums = jax.ops.segment_sum(f_flat, seg, num_segments=max_instances + 1)
         cnts = jax.ops.segment_sum(jnp.ones_like(flat_ids, f.dtype), seg,
-                                   num_segments=max_instances)
+                                   num_segments=max_instances + 1)
         means = sums / jnp.maximum(cnts, 1.0)[:, None]
         return means[seg].reshape(f.shape)
 

@@ -24,15 +24,11 @@ from flax import linen as nn
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.layers import Conv2dBlock, Res2dBlock
 from imaginaire_tpu.model_utils.pix2pixHD import instance_average
+from imaginaire_tpu.utils.misc import upsample_2x
 from imaginaire_tpu.utils.data import (
     get_paired_input_image_channel_number,
     get_paired_input_label_channel_number,
 )
-
-
-def _upsample2x(x):
-    b, h, w, c = x.shape
-    return jax.image.resize(x, (b, 2 * h, 2 * w, c), method="nearest")
 
 
 def _downsample2x_avg(x):
@@ -81,7 +77,7 @@ class GlobalGenerator(nn.Module):
                            name=f"res_{i}")(x, training=training)
         for i in reversed(range(self.num_downsamples)):
             ch = self.num_filters * (2 ** i)
-            x = _upsample2x(x)
+            x = upsample_2x(x)
             x = Conv2dBlock(ch, 3, padding=1, name=f"up_{i}",
                             **common)(x, training=training)
         if self.output_img:
@@ -126,7 +122,7 @@ class LocalEnhancer(nn.Module):
                            activation_norm_params=self.activation_norm_params,
                            nonlinearity="relu",
                            name=f"res_{i}")(x, training=training)
-        x = _upsample2x(x)
+        x = upsample_2x(x)
         x = Conv2dBlock(self.num_filters, 3, padding=1, name="up_0",
                         **common)(x, training=training)
         if self.output_img:
@@ -164,7 +160,7 @@ class Encoder(nn.Module):
                             name=f"down_{i}", **common)(x, training=training)
         for i in reversed(range(self.num_downsamples)):
             ch = self.num_filters * (2 ** i)
-            x = _upsample2x(x)
+            x = upsample_2x(x)
             x = Conv2dBlock(ch, 3, padding=1, name=f"up_{i}",
                             **common)(x, training=training)
         x = Conv2dBlock(self.num_feat_channels, 7, padding=3,

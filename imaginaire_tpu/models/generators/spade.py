@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from imaginaire_tpu.utils.misc import upsample_2x
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.layers import Conv2dBlock, LinearBlock, Res2dBlock
 from imaginaire_tpu.utils.data import (
@@ -36,11 +37,6 @@ from imaginaire_tpu.utils.data import (
     get_paired_input_image_channel_number,
     get_paired_input_label_channel_number,
 )
-
-
-def _upsample2x(x):
-    b, h, w, c = x.shape
-    return jax.image.resize(x, (b, 2 * h, 2 * w, c), method="nearest")
 
 
 class Generator(nn.Module):
@@ -221,7 +217,7 @@ class SPADEGenerator(nn.Module):
             x = plain_block(16 * nf, "conv_head_0")(x, training=training)
         x = res_block(16 * nf, "head_1")(x, seg, training=training)
         x = res_block(16 * nf, "head_2")(x, seg, training=training)
-        x = _upsample2x(x)
+        x = upsample_2x(x)
         # 32x32
         x = res_block(8 * nf, "up_0a")(x, seg, training=training)
         if self.use_style_encoder:
@@ -229,7 +225,7 @@ class SPADEGenerator(nn.Module):
         else:
             x = plain_block(8 * nf, "conv_up_0a")(x, training=training)
         x = res_block(8 * nf, "up_0b")(x, seg, training=training)
-        x = _upsample2x(x)
+        x = upsample_2x(x)
         # 64x64
         x = res_block(4 * nf, "up_1a")(x, seg, training=training)
         if self.use_style_encoder:
@@ -237,7 +233,7 @@ class SPADEGenerator(nn.Module):
         else:
             x = plain_block(4 * nf, "conv_up_1a")(x, training=training)
         x = res_block(4 * nf, "up_1b")(x, seg, training=training)
-        x = _upsample2x(x)
+        x = upsample_2x(x)
         # 128x128
         x = res_block(4 * nf, "up_2a")(x, seg, training=training)
         if self.use_style_encoder:
@@ -245,7 +241,7 @@ class SPADEGenerator(nn.Module):
         else:
             x = plain_block(4 * nf, "conv_up_2a")(x, training=training)
         x = res_block(2 * nf, "up_2b")(x, seg, training=training)
-        x = _upsample2x(x)
+        x = upsample_2x(x)
 
         size = self.out_image_small_side_size
         if size == 256:
@@ -254,17 +250,17 @@ class SPADEGenerator(nn.Module):
             x256 = img_head("conv_img256")(x, training=training)
             x = res_block(1 * nf, "up_3a")(x, seg, training=training)
             x = res_block(1 * nf, "up_3b")(x, seg, training=training)
-            x = _upsample2x(x)
+            x = upsample_2x(x)
             x512 = img_head("conv_img512")(x, training=training)
             if size == 512:
-                out = jnp.tanh(_upsample2x(x256) + x512)
+                out = jnp.tanh(upsample_2x(x256) + x512)
             else:
                 x = res_block(nf // 2, "up_4a")(x, seg, training=training)
                 x = res_block(nf // 2, "up_4b")(x, seg, training=training)
-                x = _upsample2x(x)
+                x = upsample_2x(x)
                 x1024 = img_head("conv_img1024")(x, training=training)
                 out = jnp.tanh(
-                    _upsample2x(_upsample2x(x256)) + _upsample2x(x512) + x1024)
+                    upsample_2x(upsample_2x(x256)) + upsample_2x(x512) + x1024)
         return {"fake_images": out}
 
 

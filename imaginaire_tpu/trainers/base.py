@@ -116,12 +116,24 @@ class BaseTrainer:
         int-label one-hot expansion). Default: identity."""
         return data
 
+    def _fake_output_for_init(self, data):
+        """Shape-example generator output used to init the discriminator
+        (unpaired trainers override: their D consumes images_ab/ba)."""
+        return {"fake_images": jnp.zeros_like(data["images"])}
+
     def init_state(self, key, data):
-        """Build the full train-state pytree from one example batch."""
-        data = self._init_data(data)
+        """Build the full train-state pytree from one example batch.
+
+        The Flax inits run under jit: eager init dispatches every op
+        separately (minutes on CPU for a full generator); one traced
+        program initializes in seconds.
+        """
+        from imaginaire_tpu.utils.misc import numeric_only
+
+        data = self._init_data(numeric_only(data))
         k_g, k_d, k_loss, k_noise, k_rg, k_rd = jax.random.split(key, 6)
-        vars_G = self.net_G.init({"params": k_g, "noise": k_noise},
-                                 data, training=True)
+        vars_G = jax.jit(lambda rngs, d: self.net_G.init(rngs, d, training=True))(
+            {"params": k_g, "noise": k_noise}, data)
         vars_G = dict(vars_G)
         state: Dict[str, Any] = {
             "vars_G": vars_G,
@@ -132,9 +144,10 @@ class BaseTrainer:
             "loss_params": self.init_loss_params(k_loss),
         }
         if self.net_D is not None:
-            fake_out = {"fake_images": jnp.zeros_like(data["images"])}
-            vars_D = dict(self.net_D.init({"params": k_d, "dropout": k_d},
-                                          data, fake_out, training=True))
+            fake_out = self._fake_output_for_init(data)
+            vars_D = dict(jax.jit(
+                lambda rngs, d, f: self.net_D.init(rngs, d, f, training=True))(
+                {"params": k_d, "dropout": k_d}, data, fake_out))
             state["vars_D"] = vars_D
             state["opt_D"] = self.tx_D.init(vars_D["params"])
             # Separate D step counter: with cfg.trainer.dis_step > 1 each
@@ -256,7 +269,9 @@ class BaseTrainer:
     def gen_update(self, data):
         """(ref: base.py:594-632)."""
         t0 = time.time() if self.speed_benchmark else None
-        self.state, losses = self._jit_gen_step(self.state, data)
+        from imaginaire_tpu.utils.misc import numeric_only
+
+        self.state, losses = self._jit_gen_step(self.state, numeric_only(data))
         if self.speed_benchmark:
             jax.block_until_ready(self.state["vars_G"]["params"])
             self._meter("time/gen_step").write(time.time() - t0)
@@ -268,7 +283,9 @@ class BaseTrainer:
         if self.net_D is None:
             return None
         t0 = time.time() if self.speed_benchmark else None
-        self.state, losses = self._jit_dis_step(self.state, data)
+        from imaginaire_tpu.utils.misc import numeric_only
+
+        self.state, losses = self._jit_dis_step(self.state, numeric_only(data))
         if self.speed_benchmark:
             jax.block_until_ready(self.state["vars_D"]["params"])
             self._meter("time/dis_step").write(time.time() - t0)
@@ -284,7 +301,9 @@ class BaseTrainer:
         data = self._start_of_iteration(data, current_iteration)
         self.current_iteration = current_iteration
         self.start_iteration_time = time.time()
-        return jax.tree_util.tree_map(jnp.asarray, data)
+        from imaginaire_tpu.utils.misc import to_device
+
+        return to_device(data)
 
     def end_of_iteration(self, data, current_epoch, current_iteration):
         """(ref: base.py:294-373)."""
@@ -344,8 +363,14 @@ class BaseTrainer:
 
     # --------------------------------------------------------- persistence
 
+    def _pre_save_checkpoint(self):
+        """Hook run before checkpoint serialization (ref: base.py:408-414,
+        e.g. pix2pixHD computes K-means cluster centers here)."""
+        pass
+
     def save_checkpoint(self, current_epoch, current_iteration):
         """(ref: base.py:790-829)."""
+        self._pre_save_checkpoint()
         logdir = cfg_get(self.cfg, "logdir", ".")
         meta = {"epoch": current_epoch, "iteration": current_iteration}
         path = ckpt_lib.save_checkpoint(

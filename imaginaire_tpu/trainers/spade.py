@@ -19,6 +19,7 @@ from imaginaire_tpu.losses import (
     gaussian_kl_loss,
 )
 from imaginaire_tpu.trainers.base import MUTABLE, BaseTrainer
+from imaginaire_tpu.utils.misc import to_device
 
 
 class Trainer(BaseTrainer):
@@ -39,7 +40,8 @@ class Trainer(BaseTrainer):
         self.gan_mode = cfg_get(tcfg, "gan_mode", "hinge")
         self.weights["GAN"] = tcfg.loss_weight.gan
         self.weights["FeatureMatching"] = tcfg.loss_weight.feature_matching
-        self.weights["GaussianKL"] = tcfg.loss_weight.kl
+        if cfg_get(tcfg.loss_weight, "kl", None) is not None:
+            self.weights["GaussianKL"] = tcfg.loss_weight.kl
         self.perceptual = None
         if cfg_get(tcfg, "perceptual_loss", None) is not None:
             p = tcfg.perceptual_loss
@@ -73,14 +75,12 @@ class Trainer(BaseTrainer):
         from imaginaire_tpu.utils.data import get_paired_input_label_channel_number
 
         n = get_paired_input_label_channel_number(self.cfg.data)
-        onehot = jax.nn.one_hot(label, n, dtype=self.compute_dtype
-                                if self.compute_dtype != jnp.float32
-                                else jnp.float32)
+        onehot = jax.nn.one_hot(label, n, dtype=self.compute_dtype)
         return dict(data, label=onehot)
 
     def _init_data(self, data):
         return self._expand_labels(
-            jax.tree_util.tree_map(jnp.asarray, dict(data)))
+            to_device(dict(data)))
 
     def _apply_G(self, vars_G, data, rng, training, random_style=False):
         data = self._expand_labels(data)
@@ -208,8 +208,7 @@ class Trainer(BaseTrainer):
             def gen_fn(data):
                 # side-effect-free preprocessing (start_of_iteration would
                 # clobber current_iteration/timers mid-write_metrics)
-                data = jax.tree_util.tree_map(
-                    jnp.asarray, self._start_of_iteration(data, -1))
+                data = to_device(self._start_of_iteration(data, -1))
                 out, _ = self._apply_G(variables, data, jax.random.PRNGKey(0),
                                        training=False)
                 return out["fake_images"]
@@ -228,7 +227,7 @@ class Trainer(BaseTrainer):
         """(input, label-viz, fake, [ema-fake]) strip
         (ref: trainers/spade.py:189-215)."""
         data = self._expand_labels(
-            jax.tree_util.tree_map(jnp.asarray, dict(data)))
+            to_device(dict(data)))
         rng = jax.random.PRNGKey(0)
         out, _ = self._apply_G(self.state["vars_G"], data, rng,
                                training=False, random_style=True)

@@ -40,6 +40,12 @@ def resize_nearest(x, hw):
     return jax.image.resize(x, (n, hw[0], hw[1], c), method="nearest")
 
 
+def upsample_2x(x, method="nearest"):
+    """2x spatial upsample for NHWC tensors."""
+    b, h, w, c = x.shape
+    return jax.image.resize(x, (b, 2 * h, 2 * w, c), method=method)
+
+
 def downsample_2x(x, method="bilinear"):
     n, h, w, c = x.shape
     return jax.image.resize(x, (n, h // 2, w // 2, c), method=method)
@@ -53,6 +59,47 @@ def split_labels(labels, label_lengths):
     for name, length in label_lengths.items():
         out[name] = labels[..., start:start + length]
         start += length
+    return out
+
+
+def to_device(tree):
+    """Move numeric leaves to device arrays, passing strings/bytes (e.g.
+    the dataset's per-sample 'key' field) through untouched — the jnp
+    analogue of the reference's recursive ``to_cuda``
+    (ref: utils/misc.py:56-83)."""
+    import numpy as np
+
+    def leaf(x):
+        if isinstance(x, (str, bytes)):
+            return x
+        if isinstance(x, (list, tuple)) and x and isinstance(x[0], (str, bytes)):
+            return x
+        try:
+            return jnp.asarray(x)
+        except TypeError:
+            return x
+
+    return jax.tree_util.tree_map(
+        leaf, tree, is_leaf=lambda x: isinstance(x, (str, bytes, list, tuple))
+        and not isinstance(x, np.ndarray))
+
+
+def numeric_only(tree):
+    """Drop non-array entries (sample keys, filenames) from a data dict so
+    the remainder is a valid jit argument. Recurses into dicts only —
+    lists are treated as leaves (a batch's 'key' field is a list of str)."""
+    if not isinstance(tree, dict):
+        return tree
+    out = {}
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            out[k] = numeric_only(v)
+        elif isinstance(v, (str, bytes)):
+            continue
+        elif isinstance(v, (list, tuple)) and v and isinstance(v[0], (str, bytes)):
+            continue
+        else:
+            out[k] = v
     return out
 
 

@@ -15,6 +15,7 @@ from imaginaire_tpu.optim import fromage, get_optimizer_for_params, get_schedule
 from imaginaire_tpu.utils.model_average import collapse_spectral_norm, ema_init, ema_update
 
 CFG_PATH = os.path.join(os.path.dirname(__file__), "..", "configs", "unit_test", "spade.yaml")
+CFG_P2P = os.path.join(os.path.dirname(__file__), "..", "configs", "unit_test", "pix2pixHD.yaml")
 
 
 def synthetic_batch(rng, h=256, w=256, labels=14):
@@ -192,6 +193,63 @@ class TestSPADETraining:
                    jax.tree_util.tree_leaves(trainer.state["vars_D"]["spectral"])]
         assert any(not np.allclose(x, y) for x, y in zip(u_before, u_after)), \
             "spectral u frozen across dis_update"
+
+    def test_pix2pixHD_two_iterations(self, rng, tmp_path):
+        """pix2pixHD: edge preprocessing + encoder path + no-KL loss set
+        (ref: trainers/pix2pixHD.py:49-157)."""
+        cfg = Config(CFG_P2P)
+        cfg.logdir = str(tmp_path)
+        from imaginaire_tpu.registry import resolve
+
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+
+        def batch(r):
+            # 8 seg channels + 1 instance-id channel
+            seg = (r.rand(1, 128, 128, 8) > 0.9).astype(np.float32)
+            inst = r.randint(0, 5, (1, 128, 128, 1)).astype(np.float32)
+            return {
+                "images": jnp.asarray(r.rand(1, 128, 128, 3).astype(np.float32)) * 2 - 1,
+                "label": jnp.asarray(np.concatenate([seg, inst], axis=-1)),
+            }
+
+        trainer.init_state(jax.random.PRNGKey(0), batch(rng))
+        trainer.start_of_epoch(0)
+        for it in range(1, 3):
+            b = trainer.start_of_iteration(batch(rng), it)
+            d = trainer.dis_update(b)
+            g = trainer.gen_update(b)
+            trainer.end_of_iteration(b, 0, it)
+        for name, v in {**d, **g}.items():
+            assert np.isfinite(float(jax.device_get(v))), name
+        assert "GaussianKL" not in trainer.weights
+        assert {"GAN", "FeatureMatching", "Perceptual", "total"} <= set(g)
+        # preprocessing swapped the instance channel for a binary edge map
+        assert set(np.unique(np.asarray(b["label"][..., -1]))) <= {0.0, 1.0}
+        assert "instance_maps" in b
+
+    def test_pix2pixHD_cluster_checkpoint(self, rng, tmp_path):
+        """_pre_save_checkpoint K-means features land in the state
+        (ref: trainers/pix2pixHD.py:159-173)."""
+        cfg = Config(CFG_P2P)
+        cfg.logdir = str(tmp_path)
+        from imaginaire_tpu.registry import resolve
+
+        def batch(r):
+            seg = (r.rand(1, 128, 128, 8) > 0.9).astype(np.float32)
+            inst = np.zeros((1, 128, 128, 1), np.float32)
+            inst[:, 64:, :, :] = 3.0  # two large instances
+            return {
+                "images": r.rand(1, 128, 128, 3).astype(np.float32) * 2 - 1,
+                "label": np.concatenate([seg, inst], axis=-1),
+            }
+
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        trainer.val_data_loader = [batch(rng)]
+        trainer.init_state(jax.random.PRNGKey(0), batch(rng))
+        trainer.save_checkpoint(0, 1)
+        centers = np.asarray(trainer.state["cluster_centers"])
+        assert centers.shape == (9, 4, 3)
+        assert np.abs(centers).sum() > 0
 
     def test_checkpoint_roundtrip(self, rng, tmp_path):
         cfg = Config(CFG_PATH)
