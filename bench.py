@@ -89,6 +89,7 @@ def batch_of(bs, label_ch):
 
 def main():
     import jax
+    import jax.numpy as jnp
 
     trainer, label_ch = build()
     last_error = None
@@ -101,17 +102,27 @@ def main():
                 jax.tree_util.tree_map(np.asarray, batch_of(bs, label_ch)))
             jax.block_until_ready(data)
             trainer.init_state(jax.random.PRNGKey(0), data)
+
+            def sync():
+                # a device-to-host scalar readback is the only fence that
+                # provably waits for remote completion: under tunneled TPU
+                # attachments (axon) block_until_ready acks at dispatch,
+                # which once inflated this bench 35x past chip peak.
+                leaf = jax.tree_util.tree_leaves(
+                    trainer.state["vars_G"]["params"])[0]
+                return float(jnp.sum(leaf))
+
             # warmup: compile both steps + 1 extra for stabilization
             for _ in range(2):
                 trainer.dis_update(data)
                 trainer.gen_update(data)
-            jax.block_until_ready(trainer.state["vars_G"]["params"])
+            sync()
             iters = 10
             t0 = time.time()
             for _ in range(iters):
                 trainer.dis_update(data)
                 trainer.gen_update(data)
-            jax.block_until_ready(trainer.state["vars_G"]["params"])
+            sync()
             dt = time.time() - t0
             imgs_per_sec = bs * iters / dt
             print(json.dumps({

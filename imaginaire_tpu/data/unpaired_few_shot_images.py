@@ -1,0 +1,100 @@
+"""Few-shot unpaired class dataset — FUNIT / COCO-FUNIT
+(ref: imaginaire/datasets/unpaired_few_shot_images.py:10-212).
+
+Folder layout: <root>/<data_type>/<class_name>/<files>. The first path
+segment of each sequence is its class; training samples a random
+content image and a random style image (each with its class index);
+evaluation walks one style class at a time via ``set_sample_class_idx``
+(ref: unpaired_few_shot_images.py:26-38, 96-120).
+
+Emits: images_content, images_style, labels_content, labels_style.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from imaginaire_tpu.data.base import BaseDataset
+from imaginaire_tpu.data.unpaired_images import type_sequences
+
+
+class Dataset(BaseDataset):
+    def __init__(self, cfg, is_inference=False, is_test=False):
+        super().__init__(cfg, is_inference, is_test)
+        # Per-type pools with class labels derived from the first path
+        # segment (ref: unpaired_few_shot_images.py:40-95).
+        self.items = {t: [] for t in self.data_types}
+        class_names = {t: set() for t in self.data_types}
+        for root_idx, root in enumerate(self.roots):
+            for t in self.data_types:
+                seqs = type_sequences(self, root_idx, root, t)
+                for seq, stems in seqs.items():
+                    cls = seq.split("/")[0]
+                    class_names[t].add(cls)
+                    for stem in stems:
+                        self.items[t].append((root_idx, seq, stem, cls))
+        self.class_name_to_idx = {
+            t: {c: i for i, c in enumerate(sorted(class_names[t]))}
+            for t in self.data_types}
+        self.items_by_class = {t: {} for t in self.data_types}
+        for t in self.data_types:
+            for item in self.items[t]:
+                idx = self.class_name_to_idx[t][item[3]]
+                self.items_by_class[t].setdefault(idx, []).append(item)
+        self.num_content_classes = len(self.class_name_to_idx["images_content"])
+        self.num_style_classes = len(self.class_name_to_idx["images_style"])
+        self.sample_class_idx = None
+        self.epoch_length = max(len(v) for v in self.items.values())
+
+    def set_sample_class_idx(self, class_idx=None):
+        """(ref: unpaired_few_shot_images.py:26-38)."""
+        self.sample_class_idx = class_idx
+        if class_idx is None:
+            self.epoch_length = max(len(v) for v in self.items.values())
+        else:
+            self.epoch_length = len(
+                self.items_by_class["images_style"][class_idx])
+
+    def __len__(self):
+        return self.epoch_length
+
+    def _sample_keys(self, index):
+        """(ref: unpaired_few_shot_images.py:96-133)."""
+        keys = {}
+        if self.is_inference and self.sample_class_idx is not None:
+            content_pool = self.items["images_content"]
+            keys["images_content"] = content_pool[index % len(content_pool)]
+            style_pool = self.items_by_class["images_style"][
+                self.sample_class_idx]
+            keys["images_style"] = style_pool[index % len(style_pool)]
+        else:
+            for t in self.data_types:
+                keys[t] = random.choice(self.items[t])
+        return keys
+
+    def __getitem__(self, index):
+        keys = self._sample_keys(index)
+        out = {}
+        for t in self.data_types:
+            root_idx, seq, stem, cls = keys[t]
+            arr = self.backends[t][root_idx].getitem(f"{seq}/{stem}")
+            data = {t: [arr]}
+            data = self._apply_ops(data, {t: self.pre_aug_ops[t]})
+            data, is_flipped = self.augmentor.perform_augmentation(
+                data, paired=False)
+            data = self._apply_ops(data, {t: self.post_aug_ops[t]})
+            arr = data[t][0].astype(np.float32)
+            if arr.max() > 1.5:
+                arr = arr / 255.0
+            if self.normalize[t]:
+                arr = arr * 2.0 - 1.0
+            out[t] = arr
+            label_key = "labels_" + t.split("_", 1)[1]
+            out[label_key] = np.asarray(self.class_name_to_idx[t][cls],
+                                        np.int32)
+        out["is_flipped"] = np.asarray(is_flipped)
+        out["key"] = "|".join(f"{keys[t][1]}/{keys[t][2]}"
+                              for t in self.data_types)
+        return out

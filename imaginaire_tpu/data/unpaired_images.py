@@ -1,0 +1,98 @@
+"""Unpaired two-domain image dataset — UNIT / MUNIT
+(ref: imaginaire/datasets/unpaired_images.py:10-119).
+
+Each data type (images_a, images_b) has its own independent file pool;
+training samples each domain independently at random, inference walks
+both pools with modulo indexing so differing domain sizes stay valid
+(ref: unpaired_images.py:48-70). Augmentation is per-domain (unpaired):
+each domain draws its own crop/flip (ref: unpaired_images.py:100-104).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from imaginaire_tpu.data.base import BaseDataset
+
+
+def type_sequences(dataset, root_idx, root, data_type):
+    """Per-type {sequence: [stems]} metadata for unpaired domains.
+
+    Folder backends walk <root>/<type>/; lmdb/packed backends read the
+    per-type <root>/<type>/all_filenames.json when present, else fall
+    back to a per-type key in the shared root manifest.
+    """
+    import json
+    import os
+
+    from imaginaire_tpu.data.backends import create_folder_metadata
+
+    if dataset.backend_kind == "folder":
+        return create_folder_metadata(root, [data_type])
+    per_type = os.path.join(root, data_type, "all_filenames.json")
+    if os.path.exists(per_type):
+        with open(per_type) as f:
+            return json.load(f)
+    seqs = dataset.sequence_lists[root_idx]
+    if isinstance(seqs, dict) and data_type in seqs:
+        return seqs[data_type]
+    raise ValueError(
+        f"unpaired dataset: no per-type file list for {data_type!r} under "
+        f"{root!r} (need {per_type} or a {data_type!r} key in the root "
+        "all_filenames.json — a shared sequence list would silently pair "
+        "the domains)")
+
+
+class Dataset(BaseDataset):
+    def __init__(self, cfg, is_inference=False, is_test=False):
+        super().__init__(cfg, is_inference, is_test)
+        # Per-type flattened (root, sequence, stem) pools: each domain has
+        # its own file set, so walk each type's metadata independently
+        # (base.sequence_lists only indexes the first type)
+        # (ref: unpaired_images.py:21-46).
+        self.items = {t: [] for t in self.data_types}
+        for root_idx, root in enumerate(self.roots):
+            for t in self.data_types:
+                for seq, stems in type_sequences(self, root_idx, root, t).items():
+                    for stem in stems:
+                        self.items[t].append((root_idx, seq, stem))
+        self.epoch_length = max(len(v) for v in self.items.values())
+
+    def __len__(self):
+        return self.epoch_length
+
+    def _sample_keys(self, index):
+        """(ref: unpaired_images.py:48-70)."""
+        keys = {}
+        for t in self.data_types:
+            pool = self.items[t]
+            if self.is_inference:
+                keys[t] = pool[index % len(pool)]
+            else:
+                keys[t] = random.choice(pool)
+        return keys
+
+    def __getitem__(self, index):
+        keys = self._sample_keys(index)
+        out = {}
+        for t in self.data_types:
+            root_idx, seq, stem = keys[t]
+            arr = self.backends[t][root_idx].getitem(f"{seq}/{stem}")
+            data = {t: [arr]}
+            data = self._apply_ops(data, {t: self.pre_aug_ops[t]})
+            # independent augmentation per domain (unpaired)
+            data, is_flipped = self.augmentor.perform_augmentation(
+                data, paired=False)
+            data = self._apply_ops(data, {t: self.post_aug_ops[t]})
+            arr = data[t][0].astype(np.float32)
+            if arr.max() > 1.5:
+                arr = arr / 255.0
+            if self.normalize[t]:
+                arr = arr * 2.0 - 1.0
+            out[t] = arr
+        out["is_flipped"] = np.asarray(is_flipped)
+        out["key"] = "|".join(f"{keys[t][1]}/{keys[t][2]}"
+                              for t in self.data_types)
+        return out

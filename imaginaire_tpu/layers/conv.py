@@ -94,6 +94,20 @@ class _WeightNormedConv(nn.Module):
         return out
 
 
+def _effective_order(order):
+    """Collapse repeated order chars to their first occurrence — the
+    reference keys layers by op name in a ModuleDict, so 'NACNAC' on a
+    plain (non-residual) block is effectively 'NAC' (ref: conv.py:63-69);
+    only residual blocks split a doubled order into two blocks."""
+    seen = set()
+    out = []
+    for op in order:
+        if op not in seen:
+            seen.add(op)
+            out.append(op)
+    return "".join(out)
+
+
 def _dim_numbers(nd):
     spatial = "DHW"[-nd:]
     return (f"N{spatial}C", f"{spatial}IO", f"N{spatial}C")
@@ -154,7 +168,7 @@ class _BaseConvBlock(nn.Module):
             if needs_prelu_param(self.nonlinearity)
             else None
         )
-        for op in self.order:
+        for op in _effective_order(self.order):
             if op == "C":
                 x = self._conv_module()(x, training=training, style=style)
                 if self.apply_noise:
@@ -205,7 +219,7 @@ class LinearBlock(nn.Module):
             else None
         )
         conditional = self.activation_norm_type in CONDITIONAL_NORMS
-        for op in self.order:
+        for op in _effective_order(self.order):
             if op == "C":
                 kernel = self.param(
                     "kernel", default_kernel_init, (x.shape[-1], self.out_features)
@@ -246,7 +260,7 @@ class HyperConv2dBlock(_BaseConvBlock):
             if needs_prelu_param(self.nonlinearity)
             else None
         )
-        for op in self.order:
+        for op in _effective_order(self.order):
             if op == "C":
                 if conv_weights is None or conv_weights[0] is None:
                     x = self._conv_module()(x, training=training, style=style)
@@ -338,7 +352,7 @@ class _BasePartialConvBlock(nn.Module):
             else None
         )
         mask = mask_in
-        for op in self.order:
+        for op in _effective_order(self.order):
             if op == "C":
                 x, mask = PartialConv2d(
                     features=self.out_channels,
@@ -387,7 +401,7 @@ class MultiOutConv2dBlock(_BaseConvBlock):
             else None
         )
         pre_act = x
-        for op in self.order:
+        for op in _effective_order(self.order):
             if op == "C":
                 x = self._conv_module()(x, training=training, style=style)
                 if self.apply_noise:

@@ -1,0 +1,99 @@
+"""FUNIT projection discriminator
+(ref: imaginaire/discriminators/funit.py:13-119).
+
+A residual trunk (pairs of NACNAC res blocks with reflect-pad avg-pool
+downsamples), a 1-channel patch classifier head, and a class-projection
+term: the patch logits are shifted by <class embedding, pooled features>
+(ref: funit.py:103-119, the cGAN projection trick).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from imaginaire_tpu.config import as_attrdict, cfg_get
+from imaginaire_tpu.layers import Conv2dBlock, Res2dBlock
+
+
+class FUNITResDiscriminator(nn.Module):
+    """(ref: discriminators/funit.py:52-119)."""
+
+    num_classes: int = 119
+    num_filters: int = 64
+    max_num_filters: int = 1024
+    num_layers: int = 6
+    padding_mode: str = "reflect"
+    weight_norm_type: str = ""
+
+    @nn.compact
+    def __call__(self, images, labels=None, training=False):
+        common = dict(padding_mode=self.padding_mode,
+                      activation_norm_type="none",
+                      weight_norm_type=self.weight_norm_type,
+                      bias=[True, True, True],
+                      nonlinearity="leakyrelu",
+                      order="NACNAC")
+        nf = self.num_filters
+        x = Conv2dBlock(nf, 7, stride=1, padding=3,
+                        padding_mode=self.padding_mode,
+                        weight_norm_type=self.weight_norm_type,
+                        name="conv_in")(images, training=training)
+        for i in range(self.num_layers):
+            nf_next = min(nf * 2, self.max_num_filters)
+            x = Res2dBlock(nf, name=f"res_{i}_0", **common)(
+                x, training=training)
+            x = Res2dBlock(nf_next, name=f"res_{i}_1", **common)(
+                x, training=training)
+            nf = nf_next
+            if i != self.num_layers - 1:
+                x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)),
+                            mode="reflect")
+                x = nn.avg_pool(x, (3, 3), strides=(2, 2))
+        features = x
+        outputs = Conv2dBlock(1, kernel_size=1, stride=1, padding=0,
+                              nonlinearity="leakyrelu",
+                              weight_norm_type=self.weight_norm_type,
+                              order="NACNAC", name="classifier")(
+            x, training=training)
+        features_1x1 = jnp.mean(features, axis=(1, 2))
+        if labels is None:
+            return features_1x1
+        # projection: logits += <embed(label), pooled features>
+        # (ref: funit.py:115-119)
+        embeddings = nn.Embed(self.num_classes, nf, name="embedder")(
+            labels.astype(jnp.int32))
+        proj = jnp.sum(embeddings * features_1x1, axis=1).reshape(-1, 1, 1, 1)
+        return outputs + proj, features_1x1
+
+
+class Discriminator(nn.Module):
+    """(ref: discriminators/funit.py:13-50)."""
+
+    dis_cfg: Any
+    data_cfg: Any = None
+
+    def setup(self):
+        d = as_attrdict(self.dis_cfg)
+        self.model = FUNITResDiscriminator(
+            num_classes=cfg_get(d, "num_classes", 119),
+            num_filters=cfg_get(d, "num_filters", 64),
+            max_num_filters=cfg_get(d, "max_num_filters", 1024),
+            num_layers=cfg_get(d, "num_layers", 6),
+            padding_mode=cfg_get(d, "padding_mode", "reflect"),
+            weight_norm_type=cfg_get(d, "weight_norm_type", ""))
+
+    def __call__(self, data, net_G_output, recon=True, training=False):
+        out = {}
+        out["fake_out_trans"], out["fake_features_trans"] = self.model(
+            net_G_output["images_trans"], data["labels_style"],
+            training=training)
+        out["real_out_style"], out["real_features_style"] = self.model(
+            data["images_style"], data["labels_style"], training=training)
+        if recon:
+            out["fake_out_recon"], out["fake_features_recon"] = self.model(
+                net_G_output["images_recon"], data["labels_content"],
+                training=training)
+        return out

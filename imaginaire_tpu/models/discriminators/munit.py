@@ -1,0 +1,84 @@
+"""MUNIT discriminator (ref: imaginaire/discriminators/munit.py:11-110).
+
+One discriminator per domain: multi-resolution patch (scene images,
+pixel-correspondence-preserving) or global residual (centered objects),
+selected by ``patch_wise``. Outputs out_ab/out_ba (+ real and
+reconstruction heads) with the features used by consistency
+regularization.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from flax import linen as nn
+
+from imaginaire_tpu.config import as_attrdict, cfg_get
+from imaginaire_tpu.models.discriminators.multires_patch import (
+    MultiResPatchDiscriminator,
+)
+from imaginaire_tpu.models.discriminators.residual import ResDiscriminator
+
+
+def _make_domain_dis(dis_cfg, patch_key, weight_shared, name):
+    dis_cfg = as_attrdict(dis_cfg)
+    if cfg_get(dis_cfg, patch_key, True):
+        return MultiResPatchDiscriminator(
+            num_discriminators=cfg_get(dis_cfg, "num_discriminators", 3),
+            kernel_size=cfg_get(dis_cfg, "kernel_size", 3),
+            num_filters=cfg_get(dis_cfg, "num_filters", 64),
+            num_layers=cfg_get(dis_cfg, "num_layers", 4),
+            max_num_filters=cfg_get(dis_cfg, "max_num_filters", 512),
+            activation_norm_type=cfg_get(dis_cfg, "activation_norm_type", "none"),
+            weight_norm_type=cfg_get(dis_cfg, "weight_norm_type", ""),
+            weight_shared=weight_shared,
+            name=name)
+    return ResDiscriminator(
+        num_filters=cfg_get(dis_cfg, "num_filters", 64),
+        max_num_filters=cfg_get(dis_cfg, "max_num_filters", 512),
+        first_kernel_size=cfg_get(dis_cfg, "first_kernel_size", 1),
+        num_layers=cfg_get(dis_cfg, "num_layers", 4),
+        padding_mode=cfg_get(dis_cfg, "padding_mode", "zeros"),
+        activation_norm_type=cfg_get(dis_cfg, "activation_norm_type", ""),
+        weight_norm_type=cfg_get(dis_cfg, "weight_norm_type", ""),
+        aggregation=cfg_get(dis_cfg, "aggregation", "conv"),
+        order=cfg_get(dis_cfg, "order", "pre_act"),
+        name=name)
+
+
+class Discriminator(nn.Module):
+    """(ref: discriminators/munit.py:11-110)."""
+
+    dis_cfg: Any
+    data_cfg: Any = None
+    patch_key: str = "patch_wise"
+    weight_shared: bool = False
+
+    def setup(self):
+        self.discriminator_a = _make_domain_dis(
+            self.dis_cfg, self.patch_key, self.weight_shared, "dis_a")
+        self.discriminator_b = _make_domain_dis(
+            self.dis_cfg, self.patch_key, self.weight_shared, "dis_b")
+
+    def __call__(self, data, net_G_output, real=True, gan_recon=False,
+                 training=False):
+        out = {}
+        out_ab, fea_ab, _ = self.discriminator_b(net_G_output["images_ab"],
+                                                 training=training)
+        out_ba, fea_ba, _ = self.discriminator_a(net_G_output["images_ba"],
+                                                 training=training)
+        out.update(out_ab=out_ab, out_ba=out_ba, fea_ab=fea_ab, fea_ba=fea_ba)
+        if real:
+            out_a, fea_a, _ = self.discriminator_a(data["images_a"],
+                                                   training=training)
+            out_b, fea_b, _ = self.discriminator_b(data["images_b"],
+                                                   training=training)
+            out.update(out_a=out_a, out_b=out_b, fea_a=fea_a, fea_b=fea_b)
+        if gan_recon:
+            out_aa, fea_aa, _ = self.discriminator_a(net_G_output["images_aa"],
+                                                     training=training)
+            out_bb, fea_bb, _ = self.discriminator_b(net_G_output["images_bb"],
+                                                     training=training)
+            out.update(out_aa=out_aa, out_bb=out_bb,
+                       fea_aa=fea_aa, fea_bb=fea_bb)
+        return out

@@ -1,0 +1,22 @@
+"""UNIT discriminator (ref: imaginaire/discriminators/unit.py:12-110).
+
+Same two-domain head layout as MUNIT's; the patch variant shares one
+patch discriminator's weights across the pyramid scales
+(WeightSharedMultiResPatchDiscriminator, ref: multires_patch.py:175-242),
+selected by ``patch_dis``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from imaginaire_tpu.models.discriminators.munit import (
+    Discriminator as MUNITDiscriminator,
+)
+
+
+class Discriminator(MUNITDiscriminator):
+    dis_cfg: Any
+    data_cfg: Any = None
+    patch_key: str = "patch_dis"
+    weight_shared: bool = True

@@ -1,0 +1,163 @@
+"""Improved-FUNIT generator (ref: imaginaire/generators/funit.py:15-398).
+
+A single translator: ContentEncoder (conv7 + stride-2 ladder + res
+trunk), StyleEncoder (ladder + global pool -> style vector), and a
+decoder of AdaIN residual blocks + AdaIN up-residual blocks
+(ref: funit.py:89-241). Forward mixes the content image's content code
+with the style image's style code (translation) and with its own style
+code (reconstruction) (ref: funit.py:23-41).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from imaginaire_tpu.config import as_attrdict, cfg_get
+from imaginaire_tpu.layers import Conv2dBlock, Res2dBlock, UpRes2dBlock
+from imaginaire_tpu.models.generators.munit import MLP, StyleEncoder
+
+
+class FUNITContentEncoder(nn.Module):
+    """conv7 + doubling stride-2 ladder + res trunk, CNACNA
+    (ref: funit.py:301-361). Unlike UNIT's, filters double every
+    downsample without a cap."""
+
+    num_downsamples: int = 2
+    num_res_blocks: int = 2
+    num_filters: int = 64
+    padding_mode: str = "reflect"
+    activation_norm_type: str = "instance"
+    weight_norm_type: str = ""
+    nonlinearity: str = "relu"
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        common = dict(padding_mode=self.padding_mode,
+                      activation_norm_type=self.activation_norm_type,
+                      weight_norm_type=self.weight_norm_type,
+                      nonlinearity=self.nonlinearity)
+        nf = self.num_filters
+        x = Conv2dBlock(nf, 7, stride=1, padding=3, name="conv_in",
+                        **common)(x, training=training)
+        for i in range(self.num_downsamples):
+            nf *= 2
+            x = Conv2dBlock(nf, 4, stride=2, padding=1, name=f"down_{i}",
+                            **common)(x, training=training)
+        for i in range(self.num_res_blocks):
+            x = Res2dBlock(nf, order="CNACNA", name=f"res_{i}",
+                           **common)(x, training=training)
+        return x
+
+
+class FUNITDecoder(nn.Module):
+    """Two AdaIN res blocks + AdaIN up-res ladder + conv7/tanh
+    (ref: funit.py:166-241)."""
+
+    num_upsamples: int = 2
+    num_image_channels: int = 3
+    padding_mode: str = "reflect"
+    weight_norm_type: str = ""
+    nonlinearity: str = "relu"
+
+    @nn.compact
+    def __call__(self, x, style, training=False):
+        adain = dict(activation_norm_type="adaptive",
+                     activation_norm_params=dict(base_norm="instance"),
+                     weight_norm_type=self.weight_norm_type,
+                     padding_mode=self.padding_mode,
+                     nonlinearity=self.nonlinearity)
+        nf = x.shape[-1]
+        for i in range(2):
+            x = Res2dBlock(nf, kernel_size=3, padding=1, name=f"res_{i}",
+                           **adain)(x, style, training=training)
+        for i in range(self.num_upsamples):
+            x = UpRes2dBlock(nf // 2, kernel_size=5, padding=2,
+                             hidden_channels_equal_out_channels=True,
+                             name=f"up_{i}", **adain)(x, style,
+                                                      training=training)
+            nf //= 2
+        return Conv2dBlock(self.num_image_channels, 7, stride=1, padding=3,
+                           padding_mode="reflect", nonlinearity="tanh",
+                           name="conv_out")(x, training=training)
+
+
+class FUNITTranslator(nn.Module):
+    """(ref: funit.py:69-164)."""
+
+    gen_cfg: Any
+
+    def setup(self):
+        g = as_attrdict(self.gen_cfg)
+        nf = cfg_get(g, "num_filters", 64)
+        self.style_dims = cfg_get(g, "style_dims", 64)
+        num_filters_mlp = cfg_get(g, "num_filters_mlp", 256)
+        wn = cfg_get(g, "weight_norm_type", "")
+        n_down_content = cfg_get(g, "num_downsamples_content", 2)
+        self.style_encoder = StyleEncoder(
+            num_downsamples=cfg_get(g, "num_downsamples_style", 4),
+            num_filters=nf, style_channels=self.style_dims,
+            activation_norm_type="", weight_norm_type=wn)
+        self.content_encoder = FUNITContentEncoder(
+            num_downsamples=n_down_content,
+            num_res_blocks=cfg_get(g, "num_res_blocks", 2),
+            num_filters=nf, weight_norm_type=wn)
+        self.decoder = FUNITDecoder(
+            num_upsamples=n_down_content,
+            num_image_channels=cfg_get(g, "num_image_channels", 3),
+            weight_norm_type=wn)
+        # FUNIT MLP has num_layers-3 hidden blocks (ref: funit.py:380-383)
+        self.mlp = MLP(output_dim=num_filters_mlp, latent_dim=num_filters_mlp,
+                       num_layers=cfg_get(g, "num_mlp_blocks", 3) - 1)
+
+    def encode(self, images, training=False):
+        return (self.content_encoder(images, training=training),
+                self.style_encoder(images, training=training))
+
+    def decode(self, content, style, training=False):
+        return self.decoder(content, self.mlp(style, training=training),
+                            training=training)
+
+    def __call__(self, images, training=False):
+        content, style = self.encode(images, training=training)
+        return self.decode(content, style, training=training)
+
+
+class Generator(nn.Module):
+    """(ref: funit.py:15-66)."""
+
+    gen_cfg: Any
+    data_cfg: Any = None
+    translator_cls: type = FUNITTranslator
+
+    def setup(self):
+        self.generator = self.translator_cls(self.gen_cfg)
+
+    def __call__(self, data, training=False):
+        content_a = self.generator.content_encoder(data["images_content"],
+                                                   training=training)
+        style_a = self.generator.style_encoder(data["images_content"],
+                                               training=training)
+        style_b = self.generator.style_encoder(data["images_style"],
+                                               training=training)
+        return {
+            "images_trans": self.generator.decode(content_a, style_b,
+                                                  training=training),
+            "images_recon": self.generator.decode(content_a, style_a,
+                                                  training=training),
+        }
+
+    def inference(self, data, keep_original_size=False, **kwargs):
+        """(ref: funit.py:43-66)."""
+        content_a = self.generator.content_encoder(data["images_content"])
+        style_b = self.generator.style_encoder(data["images_style"])
+        out = self.generator.decode(content_a, style_b)
+        if keep_original_size and "original_h_w" in data:
+            import jax
+
+            h, w = int(data["original_h_w"][0][0]), int(data["original_h_w"][0][1])
+            out = jax.image.resize(out, (out.shape[0], h, w, out.shape[-1]),
+                                   method="bilinear")
+        return out

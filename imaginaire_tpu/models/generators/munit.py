@@ -1,0 +1,250 @@
+"""Improved-MUNIT generator (ref: imaginaire/generators/munit.py:16-465).
+
+Each domain autoencoder = ContentEncoder (shared with UNIT) + a
+StyleEncoder that squeezes the image to a small style code + an AdaIN
+decoder whose per-block affine parameters come from an MLP over the
+style code (ref: munit.py:161-465). Cross-domain translation mixes
+content from one domain with a style sampled from the prior
+(ref: munit.py:29-112).
+
+TPU-first: random styles draw from the module's 'noise' RNG stream
+(XLA partitions the RNG op under SPMD, so per-shard styles differ for
+free); all recon flags are static trace-time switches.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from imaginaire_tpu.config import as_attrdict, cfg_get
+from imaginaire_tpu.layers import Conv2dBlock, LinearBlock, Res2dBlock
+from imaginaire_tpu.models.generators.unit import ContentEncoder
+from imaginaire_tpu.utils.misc import upsample_2x
+
+
+class StyleEncoder(nn.Module):
+    """conv7 + stride-2 ladder + global average pool -> style vector
+    (ref: munit.py:424-465)."""
+
+    num_downsamples: int = 4
+    num_filters: int = 64
+    style_channels: int = 8
+    padding_mode: str = "reflect"
+    activation_norm_type: str = ""
+    weight_norm_type: str = ""
+    nonlinearity: str = "relu"
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        common = dict(padding_mode=self.padding_mode,
+                      activation_norm_type=self.activation_norm_type,
+                      weight_norm_type=self.weight_norm_type,
+                      nonlinearity=self.nonlinearity)
+        nf = self.num_filters
+        x = Conv2dBlock(nf, 7, stride=1, padding=3, name="conv_in",
+                        **common)(x, training=training)
+        for i in range(2):
+            x = Conv2dBlock(nf * 2, 4, stride=2, padding=1, name=f"down_{i}",
+                            **common)(x, training=training)
+            nf *= 2
+        for i in range(self.num_downsamples - 2):
+            x = Conv2dBlock(nf, 4, stride=2, padding=1, name=f"down_{i + 2}",
+                            **common)(x, training=training)
+        x = jnp.mean(x, axis=(1, 2))  # AdaptiveAvgPool2d(1)
+        return LinearBlock(self.style_channels, order="C",
+                           name="fc_out")(x, training=training)
+
+
+class MLP(nn.Module):
+    """Style code -> AdaIN conditioning vector (ref: munit.py:437-465)."""
+
+    output_dim: int = 256
+    latent_dim: int = 256
+    num_layers: int = 2
+    nonlinearity: str = "relu"
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        x = x.reshape(x.shape[0], -1)
+        x = LinearBlock(self.latent_dim, nonlinearity=self.nonlinearity,
+                        name="fc_in")(x, training=training)
+        for i in range(self.num_layers - 2):
+            x = LinearBlock(self.latent_dim, nonlinearity=self.nonlinearity,
+                            name=f"fc_{i}")(x, training=training)
+        return LinearBlock(self.output_dim, nonlinearity=self.nonlinearity,
+                           name="fc_out")(x, training=training)
+
+
+class AdaINDecoder(nn.Module):
+    """Residual trunk + upsample ladder, every block AdaIN-conditioned
+    (ref: munit.py:331-421)."""
+
+    num_upsamples: int = 2
+    num_res_blocks: int = 4
+    num_image_channels: int = 3
+    style_channels: int = 256
+    padding_mode: str = "reflect"
+    activation_norm_type: str = "instance"
+    weight_norm_type: str = ""
+    nonlinearity: str = "relu"
+    output_nonlinearity: str = ""
+    pre_act: bool = False
+    apply_noise: bool = False
+
+    @nn.compact
+    def __call__(self, x, style, training=False):
+        adain_params = dict(base_norm=self.activation_norm_type or "instance")
+        common = dict(padding_mode=self.padding_mode,
+                      weight_norm_type=self.weight_norm_type,
+                      nonlinearity=self.nonlinearity,
+                      apply_noise=self.apply_noise,
+                      activation_norm_type="adaptive",
+                      activation_norm_params=adain_params)
+        order = "pre_act" if self.pre_act else "CNACNA"
+        nf = x.shape[-1]
+        for i in range(self.num_res_blocks):
+            x = Res2dBlock(nf, order=order, name=f"res_{i}", **common)(
+                x, style, training=training)
+        for i in range(self.num_upsamples):
+            x = upsample_2x(x)
+            x = Conv2dBlock(nf // 2, 5, stride=1, padding=2, name=f"up_{i}",
+                            **common)(x, style, training=training)
+            nf //= 2
+        return Conv2dBlock(self.num_image_channels, 7, stride=1, padding=3,
+                           padding_mode=self.padding_mode,
+                           nonlinearity=self.output_nonlinearity,
+                           name="conv_out")(x, training=training)
+
+
+class AutoEncoder(nn.Module):
+    """(ref: munit.py:161-329)."""
+
+    gen_cfg: Any
+
+    def setup(self):
+        g = as_attrdict(self.gen_cfg)
+        self.style_channels = cfg_get(g, "latent_dim", 8)
+        num_filters_mlp = cfg_get(g, "num_filters_mlp", 256)
+        self.style_encoder = StyleEncoder(
+            num_downsamples=cfg_get(g, "num_downsamples_style", 4),
+            num_filters=cfg_get(g, "num_filters", 64),
+            style_channels=self.style_channels,
+            activation_norm_type=cfg_get(g, "style_norm_type", ""),
+            weight_norm_type=cfg_get(g, "weight_norm_type", ""))
+        self.content_encoder = ContentEncoder(
+            num_downsamples=cfg_get(g, "num_downsamples_content", 2),
+            num_res_blocks=cfg_get(g, "num_res_blocks", 4),
+            num_filters=cfg_get(g, "num_filters", 64),
+            max_num_filters=cfg_get(g, "max_num_filters", 256),
+            activation_norm_type=cfg_get(g, "content_norm_type", "instance"),
+            weight_norm_type=cfg_get(g, "weight_norm_type", ""),
+            pre_act=cfg_get(g, "pre_act", False))
+        self.decoder = AdaINDecoder(
+            num_upsamples=cfg_get(g, "num_downsamples_content", 2),
+            num_res_blocks=cfg_get(g, "num_res_blocks", 4),
+            num_image_channels=cfg_get(g, "num_image_channels", 3),
+            style_channels=num_filters_mlp,
+            activation_norm_type=cfg_get(g, "decoder_norm_type", "instance"),
+            weight_norm_type=cfg_get(g, "weight_norm_type", ""),
+            output_nonlinearity=cfg_get(g, "output_nonlinearity", ""),
+            pre_act=cfg_get(g, "pre_act", False),
+            apply_noise=cfg_get(g, "apply_noise", False))
+        self.mlp = MLP(output_dim=num_filters_mlp,
+                       latent_dim=num_filters_mlp,
+                       num_layers=cfg_get(g, "num_mlp_blocks", 2))
+
+    def encode(self, images, training=False):
+        return (self.content_encoder(images, training=training),
+                self.style_encoder(images, training=training))
+
+    def decode(self, content, style, training=False):
+        return self.decoder(content, self.mlp(style, training=training),
+                            training=training)
+
+    def __call__(self, images, training=False):
+        content, style = self.encode(images, training=training)
+        return self.decode(content, style, training=training)
+
+
+class Generator(nn.Module):
+    """(ref: munit.py:16-159)."""
+
+    gen_cfg: Any
+    data_cfg: Any = None
+
+    def setup(self):
+        self.autoencoder_a = AutoEncoder(self.gen_cfg)
+        self.autoencoder_b = AutoEncoder(self.gen_cfg)
+
+    def __call__(self, data, training=False, random_style=True,
+                 image_recon=True, latent_recon=True, cycle_recon=True,
+                 within_latent_recon=False):
+        images_a, images_b = data["images_a"], data["images_b"]
+        out = {}
+        content_a, style_a = self.autoencoder_a.encode(images_a,
+                                                       training=training)
+        content_b, style_b = self.autoencoder_b.encode(images_b,
+                                                       training=training)
+        if image_recon:
+            out["images_aa"] = self.autoencoder_a.decode(content_a, style_a,
+                                                         training=training)
+            out["images_bb"] = self.autoencoder_b.decode(content_b, style_b,
+                                                         training=training)
+        if random_style:
+            key = self.make_rng("noise")
+            import jax
+
+            ka, kb = jax.random.split(key)
+            style_a_rand = jax.random.normal(ka, style_a.shape, style_a.dtype)
+            style_b_rand = jax.random.normal(kb, style_b.shape, style_b.dtype)
+        else:
+            style_a_rand, style_b_rand = style_a, style_b
+        images_ba = self.autoencoder_a.decode(content_b, style_a_rand,
+                                              training=training)
+        images_ab = self.autoencoder_b.decode(content_a, style_b_rand,
+                                              training=training)
+        if latent_recon or cycle_recon:
+            content_ba, style_ba = self.autoencoder_a.encode(
+                images_ba, training=training)
+            content_ab, style_ab = self.autoencoder_b.encode(
+                images_ab, training=training)
+            out.update(content_ba=content_ba, style_ba=style_ba,
+                       content_ab=content_ab, style_ab=style_ab)
+        if image_recon and within_latent_recon:
+            content_aa, style_aa = self.autoencoder_a.encode(
+                out["images_aa"], training=training)
+            content_bb, style_bb = self.autoencoder_b.encode(
+                out["images_bb"], training=training)
+            out.update(content_aa=content_aa, style_aa=style_aa,
+                       content_bb=content_bb, style_bb=style_bb)
+        if cycle_recon:
+            out["images_aba"] = self.autoencoder_a.decode(
+                out["content_ab"], style_a, training=training)
+            out["images_bab"] = self.autoencoder_b.decode(
+                out["content_ba"], style_b, training=training)
+        out.update(content_a=content_a, content_b=content_b,
+                   style_a=style_a, style_b=style_b,
+                   style_a_rand=style_a_rand, style_b_rand=style_b_rand,
+                   images_ba=images_ba, images_ab=images_ab)
+        return out
+
+    def inference(self, data, a2b=True, random_style=True, **kwargs):
+        """(ref: munit.py:113-159)."""
+        if a2b:
+            src, enc, dec = "images_a", self.autoencoder_a, self.autoencoder_b
+        else:
+            src, enc, dec = "images_b", self.autoencoder_b, self.autoencoder_a
+        content = enc.content_encoder(data[src])
+        if random_style:
+            import jax
+
+            style = jax.random.normal(
+                self.make_rng("noise"),
+                (content.shape[0], dec.style_channels), content.dtype)
+        else:
+            style_key = "images_b" if a2b else "images_a"
+            style = dec.style_encoder(data[style_key])
+        return dec.decode(content, style)

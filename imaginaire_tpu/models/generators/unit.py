@@ -1,0 +1,174 @@
+"""Improved-UNIT generator (ref: imaginaire/generators/unit.py:13-312).
+
+Two domain autoencoders sharing an architecture: a ContentEncoder
+(conv7 -> stride-2 ladder -> residual trunk) and a Decoder (residual
+trunk -> nearest-up ladder -> conv7). Translation decodes domain A
+content with domain B's decoder and vice versa; cycle reconstruction
+re-encodes the translations (ref: unit.py:26-60).
+
+TPU-first: the forward emits every requested reconstruction in one
+traced program — XLA shares the encoder work between the within-domain,
+cross-domain and cycle paths where possible; flags are static so
+inference traces contain no dead branches.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from flax import linen as nn
+
+from imaginaire_tpu.config import as_attrdict, cfg_get
+from imaginaire_tpu.layers import Conv2dBlock, Res2dBlock
+from imaginaire_tpu.utils.misc import upsample_2x
+
+
+class ContentEncoder(nn.Module):
+    """conv7 + stride-2 downsamples + residual trunk
+    (ref: unit.py:166-239)."""
+
+    num_downsamples: int = 2
+    num_res_blocks: int = 4
+    num_filters: int = 64
+    max_num_filters: int = 256
+    padding_mode: str = "reflect"
+    activation_norm_type: str = "instance"
+    weight_norm_type: str = ""
+    nonlinearity: str = "relu"
+    pre_act: bool = False
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        common = dict(padding_mode=self.padding_mode,
+                      activation_norm_type=self.activation_norm_type,
+                      weight_norm_type=self.weight_norm_type,
+                      nonlinearity=self.nonlinearity)
+        order = "pre_act" if self.pre_act else "CNACNA"
+        nf = self.num_filters
+        x = Conv2dBlock(nf, 7, stride=1, padding=3, name="conv_in",
+                        **common)(x, training=training)
+        for i in range(self.num_downsamples):
+            nf = min(nf * 2, self.max_num_filters)
+            x = Conv2dBlock(nf, 4, stride=2, padding=1, name=f"down_{i}",
+                            **common)(x, training=training)
+        for i in range(self.num_res_blocks):
+            x = Res2dBlock(nf, order=order, name=f"res_{i}",
+                           **common)(x, training=training)
+        return x
+
+
+class Decoder(nn.Module):
+    """Residual trunk + nearest-up convs + output conv7
+    (ref: unit.py:242-312)."""
+
+    num_upsamples: int = 2
+    num_res_blocks: int = 4
+    num_image_channels: int = 3
+    padding_mode: str = "reflect"
+    activation_norm_type: str = "instance"
+    weight_norm_type: str = ""
+    nonlinearity: str = "relu"
+    output_nonlinearity: str = ""
+    pre_act: bool = False
+    apply_noise: bool = False
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        common = dict(padding_mode=self.padding_mode,
+                      activation_norm_type=self.activation_norm_type,
+                      weight_norm_type=self.weight_norm_type,
+                      nonlinearity=self.nonlinearity,
+                      apply_noise=self.apply_noise)
+        order = "pre_act" if self.pre_act else "CNACNA"
+        nf = x.shape[-1]
+        for i in range(self.num_res_blocks):
+            x = Res2dBlock(nf, order=order, name=f"res_{i}",
+                           **common)(x, training=training)
+        for i in range(self.num_upsamples):
+            x = upsample_2x(x)
+            x = Conv2dBlock(nf // 2, 5, stride=1, padding=2, name=f"up_{i}",
+                            **common)(x, training=training)
+            nf //= 2
+        return Conv2dBlock(self.num_image_channels, 7, stride=1, padding=3,
+                           padding_mode=self.padding_mode,
+                           nonlinearity=self.output_nonlinearity,
+                           name="conv_out")(x, training=training)
+
+
+class AutoEncoder(nn.Module):
+    """(ref: unit.py:92-163)."""
+
+    gen_cfg: Any
+
+    def setup(self):
+        g = as_attrdict(self.gen_cfg)
+        self.content_encoder = ContentEncoder(
+            num_downsamples=cfg_get(g, "num_downsamples_content", 2),
+            num_res_blocks=cfg_get(g, "num_res_blocks", 4),
+            num_filters=cfg_get(g, "num_filters", 64),
+            max_num_filters=cfg_get(g, "max_num_filters", 256),
+            activation_norm_type=cfg_get(g, "content_norm_type", "instance"),
+            weight_norm_type=cfg_get(g, "weight_norm_type", ""),
+            pre_act=cfg_get(g, "pre_act", False))
+        self.decoder = Decoder(
+            num_upsamples=cfg_get(g, "num_downsamples_content", 2),
+            num_res_blocks=cfg_get(g, "num_res_blocks", 4),
+            num_image_channels=cfg_get(g, "num_image_channels", 3),
+            activation_norm_type=cfg_get(g, "decoder_norm_type", "instance"),
+            weight_norm_type=cfg_get(g, "weight_norm_type", ""),
+            output_nonlinearity=cfg_get(g, "output_nonlinearity", ""),
+            pre_act=cfg_get(g, "pre_act", False),
+            apply_noise=cfg_get(g, "apply_noise", False))
+
+    def __call__(self, images, training=False):
+        return self.decoder(self.content_encoder(images, training=training),
+                            training=training)
+
+
+class Generator(nn.Module):
+    """(ref: unit.py:13-89)."""
+
+    gen_cfg: Any
+    data_cfg: Any = None
+
+    def setup(self):
+        self.autoencoder_a = AutoEncoder(self.gen_cfg)
+        self.autoencoder_b = AutoEncoder(self.gen_cfg)
+
+    def __call__(self, data, training=False, image_recon=True,
+                 cycle_recon=True):
+        images_a, images_b = data["images_a"], data["images_b"]
+        out = {}
+        content_a = self.autoencoder_a.content_encoder(images_a,
+                                                       training=training)
+        content_b = self.autoencoder_b.content_encoder(images_b,
+                                                       training=training)
+        if image_recon:
+            out["images_aa"] = self.autoencoder_a.decoder(content_a,
+                                                          training=training)
+            out["images_bb"] = self.autoencoder_b.decoder(content_b,
+                                                          training=training)
+        images_ba = self.autoencoder_a.decoder(content_b, training=training)
+        images_ab = self.autoencoder_b.decoder(content_a, training=training)
+        if cycle_recon:
+            content_ba = self.autoencoder_a.content_encoder(images_ba,
+                                                            training=training)
+            content_ab = self.autoencoder_b.content_encoder(images_ab,
+                                                            training=training)
+            out.update(content_ba=content_ba, content_ab=content_ab,
+                       images_aba=self.autoencoder_a.decoder(
+                           content_ab, training=training),
+                       images_bab=self.autoencoder_b.decoder(
+                           content_ba, training=training))
+        out.update(content_a=content_a, content_b=content_b,
+                   images_ba=images_ba, images_ab=images_ab)
+        return out
+
+    def inference(self, data, a2b=True, **kwargs):
+        """(ref: unit.py:62-89)."""
+        if a2b:
+            content = self.autoencoder_a.content_encoder(data["images_a"])
+            return self.autoencoder_b.decoder(content)
+        content = self.autoencoder_b.content_encoder(data["images_b"])
+        return self.autoencoder_a.decoder(content)

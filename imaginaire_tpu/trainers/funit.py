@@ -1,0 +1,133 @@
+"""FUNIT trainer (ref: imaginaire/trainers/funit.py:17-200).
+
+Losses: GAN over translation+reconstruction streams, L1 image
+reconstruction, discriminator feature matching (pooled features), and
+optional gradient penalty (ref: funit.py:38-110). Serves FUNIT and
+COCO-FUNIT (the COCO variant only swaps the generator).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from imaginaire_tpu.config import as_attrdict, cfg_get
+from imaginaire_tpu.losses import gan_loss
+from imaginaire_tpu.trainers.base import MUTABLE, BaseTrainer
+
+
+def _l1(a, b):
+    return jnp.mean(jnp.abs(a - b))
+
+
+class Trainer(BaseTrainer):
+    def _init_loss(self, cfg):
+        """(ref: trainers/funit.py:38-52)."""
+        tcfg = cfg.trainer
+        self.gan_mode = cfg_get(tcfg, "gan_mode", "hinge")
+        for name, w in as_attrdict(cfg_get(tcfg, "loss_weight", {}) or {}).items():
+            if w and float(w) > 0:
+                self.weights[name] = float(w)
+
+    def _fake_output_for_init(self, data):
+        return {"images_trans": jnp.zeros_like(data["images_style"]),
+                "images_recon": jnp.zeros_like(data["images_content"])}
+
+    def gen_forward(self, vars_G, vars_D, loss_params, data, rng, training=True):
+        """(ref: trainers/funit.py:54-87)."""
+        out, new_mut = self.net_G.apply(
+            vars_G, data, training=training, rngs={"noise": rng},
+            mutable=list(MUTABLE))
+        d_out = self.net_D.apply(vars_D, data, out, recon=True,
+                                 training=training)
+        losses = {}
+        losses["gan"] = 0.5 * (
+            gan_loss(d_out["fake_out_trans"], True, self.gan_mode,
+                     dis_update=False)
+            + gan_loss(d_out["fake_out_recon"], True, self.gan_mode,
+                       dis_update=False))
+        losses["image_recon"] = _l1(out["images_recon"],
+                                    data["images_content"])
+        losses["feature_matching"] = _l1(d_out["fake_features_trans"],
+                                         d_out["real_features_style"])
+        return losses, new_mut
+
+    def dis_forward(self, vars_G, vars_D, loss_params, data, rng, training=True):
+        """(ref: trainers/funit.py:89-110)."""
+        out, _ = self.net_G.apply(
+            vars_G, data, training=training, rngs={"noise": rng},
+            mutable=list(MUTABLE))
+        out = jax.lax.stop_gradient(out)
+        d_out, new_mut_D = self.net_D.apply(
+            vars_D, data, out, recon=False, training=training,
+            mutable=list(MUTABLE))
+        losses = {"gan": (
+            gan_loss(d_out["real_out_style"], True, self.gan_mode,
+                     dis_update=True)
+            + gan_loss(d_out["fake_out_trans"], False, self.gan_mode,
+                       dis_update=True))}
+        if "gp" in self.weights:
+            from imaginaire_tpu.utils.misc import gradient_penalty
+
+            def d_apply(params, x):
+                o, _ = self.net_D.apply(
+                    vars_D, x, data["labels_style"], training=training,
+                    method=lambda mdl, im, lbl, training: mdl.model(
+                        im, lbl, training=training))
+                return o
+
+            losses["gp"] = gradient_penalty(d_apply, None,
+                                            out["images_trans"], rng)
+        return losses, new_mut_D
+
+    def _get_visualizations(self, data):
+        """(ref: trainers/funit.py:112-131)."""
+        from imaginaire_tpu.utils.misc import to_device
+
+        data = to_device(dict(data))
+        out, _ = self.net_G.apply(
+            self.inference_params(), data, training=False,
+            rngs={"noise": jax.random.PRNGKey(0)}, mutable=list(MUTABLE))
+        return [data["images_content"], data["images_style"],
+                out["images_recon"], out["images_trans"]]
+
+    def _compute_fid(self):
+        """Mean per-style-class FID (ref: trainers/funit.py:133-166)."""
+        if self.val_data_loader is None:
+            return None
+        import numpy as np
+
+        from imaginaire_tpu.evaluation import compute_fid, inception
+
+        dataset = getattr(self.val_data_loader, "dataset", None)
+        if dataset is None or not hasattr(dataset, "num_style_classes"):
+            return None
+        try:
+            variables = inception.load_params(
+                random_init=cfg_get(cfg_get(self.cfg, "trainer", {}),
+                                    "fid_random_init", False))
+        except FileNotFoundError as e:
+            print(f"FID skipped: {e}")
+            return None
+        extractor = inception.make_extractor(variables)
+        gen_vars = self.inference_params()
+
+        def gen_fn(data):
+            from imaginaire_tpu.utils.misc import to_device
+
+            return self.net_G.apply(
+                gen_vars, to_device(dict(data)),
+                rngs={"noise": jax.random.PRNGKey(0)},
+                method=self.net_G.inference)
+
+        import os
+
+        logdir = cfg_get(self.cfg, "logdir", ".")
+        fids = []
+        for class_idx in range(dataset.num_style_classes):
+            dataset.set_sample_class_idx(class_idx)
+            path = os.path.join(logdir, f"real_stats_style{class_idx}.npz")
+            fids.append(compute_fid(path, self.val_data_loader, extractor,
+                                    gen_fn, key_real="images_style"))
+        dataset.set_sample_class_idx(None)
+        return float(np.mean(fids))

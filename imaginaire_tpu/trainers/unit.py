@@ -1,0 +1,84 @@
+"""UNIT trainer (ref: imaginaire/trainers/unit.py:14-229).
+
+Loss terms: two-domain GAN, within-domain image reconstruction, cycle
+reconstruction, optional perceptual (ref: unit.py:55-140). Shares the
+unpaired two-domain scaffolding with the MUNIT trainer; UNIT has no
+style code, so the style/content/kl terms never activate.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from imaginaire_tpu.losses import gan_loss
+from imaginaire_tpu.trainers.munit import Trainer as MUNITTrainer, _l1
+
+
+class Trainer(MUNITTrainer):
+    def _apply_G(self, vars_G, data, rng, training, **flags):
+        """UNIT's generator takes no style flags (ref: generators/unit.py:26)."""
+        from imaginaire_tpu.trainers.base import MUTABLE
+
+        flags.pop("random_style", None)
+        flags.pop("latent_recon", None)
+        flags.pop("within_latent_recon", None)
+        return self.net_G.apply(vars_G, data, training=training,
+                                rngs={"noise": rng}, mutable=list(MUTABLE),
+                                **flags)
+
+    def gen_forward(self, vars_G, vars_D, loss_params, data, rng, training=True):
+        """(ref: trainers/unit.py:79-140)."""
+        cycle = "cycle_recon" in self.weights
+        out, new_mut = self._apply_G(vars_G, data, rng, training,
+                                     image_recon=True, cycle_recon=cycle)
+        d_out = self.net_D.apply(vars_D, data, out, real=False,
+                                 training=training)
+        losses = {}
+        losses["gan"] = (
+            gan_loss(d_out["out_ba"], True, self.gan_mode, dis_update=False)
+            + gan_loss(d_out["out_ab"], True, self.gan_mode, dis_update=False))
+        if self.perceptual is not None:
+            losses["perceptual"] = (
+                self.perceptual(loss_params["perceptual"], out["images_ab"],
+                                data["images_a"])
+                + self.perceptual(loss_params["perceptual"], out["images_ba"],
+                                  data["images_b"]))
+        losses["image_recon"] = (_l1(out["images_aa"], data["images_a"])
+                                 + _l1(out["images_bb"], data["images_b"]))
+        if cycle:
+            losses["cycle_recon"] = (_l1(out["images_aba"], data["images_a"])
+                                     + _l1(out["images_bab"], data["images_b"]))
+        return losses, new_mut
+
+    def dis_forward(self, vars_G, vars_D, loss_params, data, rng, training=True):
+        """(ref: trainers/unit.py:142-173)."""
+        from imaginaire_tpu.trainers.base import MUTABLE
+
+        out, _ = self._apply_G(vars_G, data, rng, training,
+                               image_recon=False, cycle_recon=False)
+        out = jax.lax.stop_gradient(
+            {k: v for k, v in out.items() if k.startswith("images_")})
+        d_out, new_mut_D = self.net_D.apply(
+            vars_D, data, out, real=True, training=training,
+            mutable=list(MUTABLE))
+        losses = {"gan": (
+            gan_loss(d_out["out_a"], True, self.gan_mode, dis_update=True)
+            + gan_loss(d_out["out_ba"], False, self.gan_mode, dis_update=True)
+            + gan_loss(d_out["out_b"], True, self.gan_mode, dis_update=True)
+            + gan_loss(d_out["out_ab"], False, self.gan_mode, dis_update=True))}
+        return losses, new_mut_D
+
+    def _get_visualizations(self, data):
+        """(ref: trainers/unit.py:175-198)."""
+        from imaginaire_tpu.utils.misc import to_device
+
+        data = to_device(dict(data))
+        variables = self.inference_params()
+        out, _ = self._apply_G(variables, data, jax.random.PRNGKey(0),
+                               training=False, image_recon=True,
+                               cycle_recon=True)
+        return [data["images_a"], data["images_b"],
+                out["images_aa"], out["images_bb"],
+                out["images_ab"], out["images_ba"],
+                out["images_aba"], out["images_bab"]]

@@ -114,6 +114,24 @@ def to_bf16(tree):
     )
 
 
+def random_shift(x, key, offset=0.05):
+    """Randomly translate each image, reflect-padded
+    (ref: utils/misc.py:183-203, a bilinear grid_sample; here integer-pixel
+    shifts via reflect pad + per-sample dynamic_slice — jit/vmap friendly,
+    no gather grid)."""
+    b, h, w, c = x.shape
+    mh, mw = max(1, int(offset * h)), max(1, int(offset * w))
+    pad = jnp.pad(x, ((0, 0), (mh, mh), (mw, mw), (0, 0)), mode="reflect")
+    ky, kx = jax.random.split(key)
+    oy = jax.random.randint(ky, (b,), 0, 2 * mh + 1)
+    ox = jax.random.randint(kx, (b,), 0, 2 * mw + 1)
+
+    def one(img, y0, x0):
+        return jax.lax.dynamic_slice(img, (y0, x0, 0), (h, w, c))
+
+    return jax.vmap(one)(pad, oy, ox)
+
+
 def gradient_penalty(d_apply, params, images, key):
     """R1-style gradient penalty helper used by MUNIT's optional GP
     (ref: trainers/munit.py gp loss): E[||∇_x D(x)||²]."""
