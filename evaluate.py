@@ -1,0 +1,77 @@
+"""Evaluation entry point (ref: evaluate.py:33-81).
+
+Walk the checkpoints in --checkpoint_logdir (or the single --checkpoint),
+restore each, and run the trainer's metric computation (FID et al.) over
+the validation set.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+
+import jax
+
+from imaginaire_tpu.config import Config
+from imaginaire_tpu.data import get_train_and_val_dataloader
+from imaginaire_tpu.parallel.mesh import (
+    create_mesh,
+    master_only_print as print,  # noqa: A001
+    set_mesh,
+)
+from imaginaire_tpu.registry import resolve
+from imaginaire_tpu.utils.logging_utils import init_logging, make_logging_dir
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description="imaginaire-tpu evaluation")
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--logdir", default=None,
+                        help="Dir for saving evaluation results.")
+    parser.add_argument("--checkpoint_logdir", default=None,
+                        help="Dir whose checkpoints are each evaluated.")
+    parser.add_argument("--checkpoint", default=None,
+                        help="Evaluate one specific checkpoint.")
+    parser.add_argument("--seed", type=int, default=0)
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    cfg = Config(args.config)
+    set_mesh(create_mesh(tuple(cfg.runtime.mesh.axes),
+                         cfg.runtime.mesh.shape))
+    date_uid, logdir = init_logging(args.config, args.logdir)
+    make_logging_dir(logdir)
+    cfg.logdir = logdir
+
+    train_loader, val_loader = get_train_and_val_dataloader(cfg,
+                                                            seed=args.seed)
+    trainer_cls = resolve(cfg.trainer.type, "Trainer")
+    trainer = trainer_cls(cfg, train_data_loader=train_loader,
+                          val_data_loader=val_loader)
+    sample = next(iter(val_loader))
+    sample = trainer.start_of_iteration(sample, 0)
+    trainer.init_state(jax.random.PRNGKey(args.seed), sample)
+
+    if args.checkpoint:
+        checkpoints = [args.checkpoint]
+    elif args.checkpoint_logdir:
+        checkpoints = sorted(
+            p for p in glob.glob(os.path.join(args.checkpoint_logdir,
+                                              "*checkpoint*"))
+            if os.path.isdir(p) or p.endswith((".ckpt", ".orbax")))
+    else:
+        raise SystemExit("pass --checkpoint or --checkpoint_logdir")
+
+    for checkpoint in checkpoints:
+        trainer.load_checkpoint(checkpoint, resume=True)
+        print(f"Evaluating {checkpoint} (epoch {trainer.current_epoch}, "
+              f"iteration {trainer.current_iteration})")
+        trainer.write_metrics()
+    print("Done with evaluation!!!")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Paired video dataset — vid2vid family
+(ref: imaginaire/datasets/paired_videos.py:24-316).
+
+Sequences of aligned frames per data type. ``sequence_length`` is
+mutable: the trainer's curriculum doubles it as temporal training
+progresses (``set_sequence_length``, ref: paired_videos.py:74-89);
+sampling picks a sequence with at least that many frames and a random
+start offset. Output tensors are (T, H, W, C); the loader collates to
+(B, T, H, W, C).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from imaginaire_tpu.config import cfg_get
+from imaginaire_tpu.data.base import BaseDataset
+
+
+class Dataset(BaseDataset):
+    def __init__(self, cfg, is_inference=False, is_test=False):
+        super().__init__(cfg, is_inference, is_test)
+        self.sequence_length = int(
+            cfg_get(self.data_info, "initial_sequence_length", 1)
+            if not is_inference else 1)
+        # Flatten (root, sequence) with frame lists.
+        self.sequences = []
+        self.sequence_length_max = 0
+        for root_idx, seqs in enumerate(self.sequence_lists):
+            for seq, stems in seqs.items():
+                self.sequences.append((root_idx, seq, list(stems)))
+                self.sequence_length_max = max(self.sequence_length_max,
+                                               len(stems))
+        # clamp here too: the first batch is fetched before the trainer's
+        # curriculum ever calls set_sequence_length
+        self.sequence_length = min(self.sequence_length,
+                                   max(self.sequence_length_max, 1))
+        self._rebuild()
+
+    def set_sequence_length(self, sequence_length):
+        """(ref: paired_videos.py:74-89)."""
+        sequence_length = min(int(sequence_length), self.sequence_length_max)
+        self.sequence_length = sequence_length
+        self._rebuild()
+
+    def _rebuild(self):
+        self.valid = [s for s in self.sequences
+                      if len(s[2]) >= self.sequence_length]
+        self.epoch_length = max(len(self.valid), 1)
+
+    def __len__(self):
+        return self.epoch_length
+
+    def __getitem__(self, index):
+        root_idx, seq, stems = self.valid[index % len(self.valid)]
+        max_start = len(stems) - self.sequence_length
+        start = (0 if self.is_inference
+                 else random.randint(0, max_start) if max_start > 0 else 0)
+        frames = stems[start:start + self.sequence_length]
+        raw = self.load_item(root_idx, seq, frames)
+        out = self.process_item(raw)
+        out = self.concat_labels(out)  # keeps (T, H, W, C)
+        out["key"] = f"{seq}/{frames[-1]}"
+        return out

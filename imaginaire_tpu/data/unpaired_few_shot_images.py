@@ -75,26 +75,19 @@ class Dataset(BaseDataset):
         return keys
 
     def __getitem__(self, index):
+        from imaginaire_tpu.data.unpaired_images import load_unpaired_type
+
         keys = self._sample_keys(index)
         out = {}
+        flips = []
         for t in self.data_types:
             root_idx, seq, stem, cls = keys[t]
-            arr = self.backends[t][root_idx].getitem(f"{seq}/{stem}")
-            data = {t: [arr]}
-            data = self._apply_ops(data, {t: self.pre_aug_ops[t]})
-            data, is_flipped = self.augmentor.perform_augmentation(
-                data, paired=False)
-            data = self._apply_ops(data, {t: self.post_aug_ops[t]})
-            arr = data[t][0].astype(np.float32)
-            if arr.max() > 1.5:
-                arr = arr / 255.0
-            if self.normalize[t]:
-                arr = arr * 2.0 - 1.0
-            out[t] = arr
+            out[t], flipped = load_unpaired_type(self, t, root_idx, seq, stem)
+            flips.append(flipped)
             label_key = "labels_" + t.split("_", 1)[1]
             out[label_key] = np.asarray(self.class_name_to_idx[t][cls],
                                         np.int32)
-        out["is_flipped"] = np.asarray(is_flipped)
+        out["is_flipped"] = np.asarray(flips)
         out["key"] = "|".join(f"{keys[t][1]}/{keys[t][2]}"
                               for t in self.data_types)
         return out

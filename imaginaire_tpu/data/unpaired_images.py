@@ -45,6 +45,27 @@ def type_sequences(dataset, root_idx, root, data_type):
         "the domains)")
 
 
+def load_unpaired_type(dataset, data_type, root_idx, seq, stem):
+    """Load + independently augment + normalize one domain's image.
+
+    Shared by the unpaired and few-shot datasets. Returns
+    (HWC float32 array, is_flipped bool for this domain's own draw).
+    """
+    arr = dataset.backends[data_type][root_idx].getitem(f"{seq}/{stem}")
+    data = {data_type: [arr]}
+    data = dataset._apply_ops(data, {data_type: dataset.pre_aug_ops[data_type]})
+    data, is_flipped = dataset.augmentor.perform_augmentation(
+        data, paired=False)
+    data = dataset._apply_ops(data,
+                              {data_type: dataset.post_aug_ops[data_type]})
+    arr = data[data_type][0].astype(np.float32)
+    if arr.max() > 1.5:
+        arr = arr / 255.0
+    if dataset.normalize[data_type]:
+        arr = arr * 2.0 - 1.0
+    return arr, is_flipped
+
+
 class Dataset(BaseDataset):
     def __init__(self, cfg, is_inference=False, is_test=False):
         super().__init__(cfg, is_inference, is_test)
@@ -77,22 +98,13 @@ class Dataset(BaseDataset):
     def __getitem__(self, index):
         keys = self._sample_keys(index)
         out = {}
+        flips = []
         for t in self.data_types:
             root_idx, seq, stem = keys[t]
-            arr = self.backends[t][root_idx].getitem(f"{seq}/{stem}")
-            data = {t: [arr]}
-            data = self._apply_ops(data, {t: self.pre_aug_ops[t]})
-            # independent augmentation per domain (unpaired)
-            data, is_flipped = self.augmentor.perform_augmentation(
-                data, paired=False)
-            data = self._apply_ops(data, {t: self.post_aug_ops[t]})
-            arr = data[t][0].astype(np.float32)
-            if arr.max() > 1.5:
-                arr = arr / 255.0
-            if self.normalize[t]:
-                arr = arr * 2.0 - 1.0
-            out[t] = arr
-        out["is_flipped"] = np.asarray(is_flipped)
+            out[t], flipped = load_unpaired_type(self, t, root_idx, seq, stem)
+            flips.append(flipped)
+        # per-domain flags: each domain draws its own flip
+        out["is_flipped"] = np.asarray(flips)
         out["key"] = "|".join(f"{keys[t][1]}/{keys[t][2]}"
                               for t in self.data_types)
         return out

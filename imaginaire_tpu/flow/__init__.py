@@ -1,0 +1,13 @@
+"""FlowNet2 port (ref: imaginaire/third_party/flow_net)."""
+
+from imaginaire_tpu.flow.flow_net import FlowNet
+from imaginaire_tpu.flow.flownet2 import (
+    FlowNet2,
+    FlowNetC,
+    FlowNetFusion,
+    FlowNetS,
+    FlowNetSD,
+)
+
+__all__ = ["FlowNet", "FlowNet2", "FlowNetC", "FlowNetS", "FlowNetSD",
+           "FlowNetFusion"]

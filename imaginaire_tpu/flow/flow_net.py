@@ -1,0 +1,104 @@
+"""Frozen FlowNet2 wrapper producing (flow, confidence)
+(ref: imaginaire/third_party/flow_net/flow_net.py:17-94).
+
+Resizes inputs to a /64 grid, runs the cascade, and derives a
+confidence map from the warp error (||im1 - warp(im2, flow)||² < 0.02).
+Weights load from a converted torch checkpoint
+(scripts/convert_weights.py --flownet2); absent weights raise unless
+``allow_random_init`` (tests only — vid2vid's fork semantics train
+without a flow teacher, so this wrapper is optional at train time).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from imaginaire_tpu.flow.flownet2 import FlowNet2
+from imaginaire_tpu.model_utils.fs_vid2vid import resample
+
+DEFAULT_WEIGHTS = os.path.join(os.path.dirname(__file__), "weights",
+                               "flownet2.npz")
+
+
+def _sq_norm(t):
+    return jnp.sum(t * t, axis=-1, keepdims=True)
+
+
+class FlowNet:
+    def __init__(self, weights_path=None, allow_random_init=False,
+                 rgb_max=1.0):
+        self.model = FlowNet2(rgb_max=rgb_max)
+        self.params = None
+        self.weights_path = weights_path or DEFAULT_WEIGHTS
+        self.allow_random_init = allow_random_init
+        self._jit_flow = jax.jit(self._flow_fn)
+
+    def init_params(self, key, image_shape=(1, 64, 64, 3)):
+        if os.path.exists(self.weights_path):
+            self.params = load_flownet2_npz(self.weights_path)
+        elif self.allow_random_init:
+            # param shapes are resolution-independent; init on the /64
+            # grid the forward always resizes to
+            self.params = self.model.init(
+                key, jnp.zeros((1, 2, 64, 64, 3)))["params"]
+        else:
+            raise FileNotFoundError(
+                f"FlowNet2 weights not found at {self.weights_path}; run "
+                "scripts/convert_weights.py --flownet2 <ckpt> or pass "
+                "allow_random_init=True (tests only)")
+        return self.params
+
+    def _flow_fn(self, params, im1, im2):
+        """(ref: flow_net.py:54-91)."""
+        b, old_h, old_w, _ = im1.shape
+        new_h, new_w = old_h // 64 * 64, old_w // 64 * 64
+        if (new_h, new_w) != (old_h, old_w):
+            im1_r = jax.image.resize(im1, (b, new_h, new_w, 3), "bilinear")
+            im2_r = jax.image.resize(im2, (b, new_h, new_w, 3), "bilinear")
+        else:
+            im1_r, im2_r = im1, im2
+        data = jnp.stack([im1_r, im2_r], axis=1)
+        flow = self.model.apply({"params": params}, data, training=False)
+        conf = (_sq_norm(im1_r - resample(im2_r, flow)) < 0.02).astype(
+            jnp.float32)
+        if (new_h, new_w) != (old_h, old_w):
+            flow = jax.image.resize(flow, (b, old_h, old_w, 2), "bilinear")
+            # per-axis rescale of the pixel-unit components (the reference
+            # scales both by old_h/new_h — a bug for non-uniform resizes,
+            # flow_net.py:86-88; flow[...,0] is x, [...,1] is y)
+            flow = flow * jnp.asarray([old_w / new_w, old_h / new_h],
+                                      flow.dtype)
+            conf = jax.image.resize(conf, (b, old_h, old_w, 1), "bilinear")
+        return flow, conf
+
+    def __call__(self, input_a, input_b):
+        """Accepts (B,H,W,3), (B,N,H,W,3) or (B,T,N,H,W,3) pairs
+        (ref: flow_net.py:35-52)."""
+        if self.params is None:
+            self.init_params(jax.random.PRNGKey(0), input_a.shape[-4:])
+        shape = input_a.shape
+        if input_a.ndim >= 5:
+            flat_a = input_a.reshape((-1,) + shape[-3:])
+            flat_b = input_b.reshape(flat_a.shape)
+            flow, conf = self._jit_flow(self.params, flat_a, flat_b)
+            lead = shape[:-3]
+            return (flow.reshape(lead + flow.shape[1:]),
+                    conf.reshape(lead + conf.shape[1:]))
+        return self._jit_flow(self.params, input_a, input_b)
+
+
+def load_flownet2_npz(path):
+    """Load a converted checkpoint into the Flax param tree."""
+    flat = dict(np.load(path))
+    params = {}
+    for key, value in flat.items():
+        node = params
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(value)
+    return params

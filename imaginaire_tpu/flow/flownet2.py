@@ -1,0 +1,342 @@
+"""FlowNet2 in Flax
+(ref: imaginaire/third_party/flow_net/flownet2/models.py:20-173,
+networks/flownet_c.py, flownet_s.py, flownet_sd.py, flownet_fusion.py,
+submodules.py — themselves from github.com/NVIDIA/flownet2-pytorch).
+
+The full FlowNet2 cascade: FlowNetC (correlation cost volume) ->
+FlowNetS1 -> FlowNetS2 on warped concats, FlowNetSD on the raw pair,
+and a fusion net combining both flow branches. The correlation, warp
+and channel-norm primitives are this framework's native TPU ops
+(ops/correlation, ops/resample2d, ops/channelnorm).
+
+NHWC throughout; ``load_torch_flownet2_weights`` transposes a ported
+torch checkpoint (see scripts/convert_weights.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from imaginaire_tpu.ops.channelnorm import channelnorm
+from imaginaire_tpu.ops.correlation import correlation
+from imaginaire_tpu.ops.resample2d import resample2d
+
+
+def _leaky(x):
+    return nn.leaky_relu(x, 0.1)
+
+
+class ConvBlock(nn.Module):
+    """conv(+BN)+leakyrelu (ref: submodules.py:12-34)."""
+
+    features: int
+    kernel_size: int = 3
+    stride: int = 1
+    use_batch_norm: bool = False
+    activate: bool = True
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        pad = (self.kernel_size - 1) // 2
+        x = nn.Conv(self.features, (self.kernel_size, self.kernel_size),
+                    strides=(self.stride, self.stride),
+                    padding=((pad, pad), (pad, pad)),
+                    use_bias=not self.use_batch_norm, name="conv")(x)
+        if self.use_batch_norm:
+            x = nn.BatchNorm(use_running_average=not training,
+                             momentum=0.9, epsilon=1e-5, name="bn")(x)
+        if self.activate:
+            x = _leaky(x)
+        return x
+
+
+class Deconv(nn.Module):
+    """ConvTranspose k4 s2 p1 + leakyrelu (ref: submodules.py:69-75)."""
+
+    features: int
+    use_bias: bool = True
+    activate: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        # torch ConvTranspose2d(k=4, s=2, p=1) == lax padding k-1-p = 2
+        x = nn.ConvTranspose(self.features, (4, 4), strides=(2, 2),
+                             padding=((2, 2), (2, 2)),
+                             use_bias=self.use_bias, name="deconv")(x)
+        if self.activate:
+            x = _leaky(x)
+        return x
+
+
+class PredictFlow(nn.Module):
+    """3x3 conv to 2 channels (ref: submodules.py:64-66)."""
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Conv(2, (3, 3), padding=((1, 1), (1, 1)), name="conv")(x)
+
+
+class _Refine(nn.Module):
+    """Shared S/C decoder rung: predict flow, upsample it, deconv the
+    features, concat (ref: flownet_s.py:96-117)."""
+
+    deconv_features: int
+    upflow_bias: bool = True
+
+    @nn.compact
+    def __call__(self, feat, skip):
+        flow = PredictFlow(name="predict")(feat)
+        flow_up = nn.ConvTranspose(2, (4, 4), strides=(2, 2),
+                                   padding=((2, 2), (2, 2)),
+                                   use_bias=self.upflow_bias,
+                                   name="upflow")(flow)
+        de = Deconv(self.deconv_features, name="deconv")(feat)
+        return flow, jnp.concatenate([skip, de, flow_up], axis=-1)
+
+
+class FlowNetC(nn.Module):
+    """(ref: flownet_c.py:14-160)."""
+
+    use_batch_norm: bool = False
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        bn = self.use_batch_norm
+        conv1 = ConvBlock(64, 7, 2, bn, name="conv1")
+        conv2 = ConvBlock(128, 5, 2, bn, name="conv2")
+        conv3 = ConvBlock(256, 5, 2, bn, name="conv3")
+        x1, x2 = x[..., 0:3], x[..., 3:]
+        out_conv1a = conv1(x1, training)
+        out_conv2a = conv2(out_conv1a, training)
+        out_conv3a = conv3(out_conv2a, training)
+        out_conv1b = conv1(x2, training)
+        out_conv2b = conv2(out_conv1b, training)
+        out_conv3b = conv3(out_conv2b, training)
+
+        out_corr = _leaky(correlation(
+            out_conv3a, out_conv3b, pad_size=20, kernel_size=1,
+            max_displacement=20, stride1=1, stride2=2))
+        out_redir = ConvBlock(32, 1, 1, bn, name="conv_redir")(
+            out_conv3a, training)
+        x = jnp.concatenate([out_redir, out_corr], axis=-1)
+
+        out_conv3_1 = ConvBlock(256, 3, 1, bn, name="conv3_1")(x, training)
+        out_conv4 = ConvBlock(512, 3, 1, bn, name="conv4_1")(
+            ConvBlock(512, 3, 2, bn, name="conv4")(out_conv3_1, training),
+            training)
+        out_conv5 = ConvBlock(512, 3, 1, bn, name="conv5_1")(
+            ConvBlock(512, 3, 2, bn, name="conv5")(out_conv4, training),
+            training)
+        out_conv6 = ConvBlock(1024, 3, 1, bn, name="conv6_1")(
+            ConvBlock(1024, 3, 2, bn, name="conv6")(out_conv5, training),
+            training)
+
+        flow6, concat5 = _Refine(512, name="refine5")(out_conv6, out_conv5)
+        flow5, concat4 = _Refine(256, name="refine4")(concat5, out_conv4)
+        flow4, concat3 = _Refine(128, name="refine3")(concat4, out_conv3_1)
+        flow3, concat2 = _Refine(64, name="refine2")(concat3, out_conv2a)
+        flow2 = PredictFlow(name="predict_flow2")(concat2)
+        return flow2, flow3, flow4, flow5, flow6
+
+
+class FlowNetS(nn.Module):
+    """(ref: flownet_s.py:16-121)."""
+
+    input_channels: int = 12
+    use_batch_norm: bool = False
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        bn = self.use_batch_norm
+        out_conv1 = ConvBlock(64, 7, 2, bn, name="conv1")(x, training)
+        out_conv2 = ConvBlock(128, 5, 2, bn, name="conv2")(out_conv1,
+                                                           training)
+        out_conv3 = ConvBlock(256, 3, 1, bn, name="conv3_1")(
+            ConvBlock(256, 5, 2, bn, name="conv3")(out_conv2, training),
+            training)
+        out_conv4 = ConvBlock(512, 3, 1, bn, name="conv4_1")(
+            ConvBlock(512, 3, 2, bn, name="conv4")(out_conv3, training),
+            training)
+        out_conv5 = ConvBlock(512, 3, 1, bn, name="conv5_1")(
+            ConvBlock(512, 3, 2, bn, name="conv5")(out_conv4, training),
+            training)
+        out_conv6 = ConvBlock(1024, 3, 1, bn, name="conv6_1")(
+            ConvBlock(1024, 3, 2, bn, name="conv6")(out_conv5, training),
+            training)
+        # S variant's flow upsamplers have no bias (ref: flownet_s.py:58-66)
+        flow6, concat5 = _Refine(512, False, name="refine5")(out_conv6,
+                                                             out_conv5)
+        flow5, concat4 = _Refine(256, False, name="refine4")(concat5,
+                                                             out_conv4)
+        flow4, concat3 = _Refine(128, False, name="refine3")(concat4,
+                                                             out_conv3)
+        flow3, concat2 = _Refine(64, False, name="refine2")(concat3,
+                                                            out_conv2)
+        flow2 = PredictFlow(name="predict_flow2")(concat2)
+        return flow2, flow3, flow4, flow5, flow6
+
+
+class _RefineSD(nn.Module):
+    """SD/fusion rung with an intermediate conv before flow prediction
+    (ref: flownet_sd.py:100-118)."""
+
+    inter_features: int
+    deconv_features: int
+    use_batch_norm: bool = False
+
+    @nn.compact
+    def __call__(self, feat, skip):
+        inter = ConvBlock(self.inter_features, 3, 1, self.use_batch_norm,
+                          activate=False, name="inter")(feat)
+        flow = PredictFlow(name="predict")(inter)
+        flow_up = nn.ConvTranspose(2, (4, 4), strides=(2, 2),
+                                   padding=((2, 2), (2, 2)),
+                                   name="upflow")(flow)
+        de = Deconv(self.deconv_features, name="deconv")(feat)
+        return flow, jnp.concatenate([skip, de, flow_up], axis=-1)
+
+
+class FlowNetSD(nn.Module):
+    """(ref: flownet_sd.py:13-121)."""
+
+    use_batch_norm: bool = False
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        bn = self.use_batch_norm
+        out_conv0 = ConvBlock(64, 3, 1, bn, name="conv0")(x, training)
+        out_conv1 = ConvBlock(128, 3, 1, bn, name="conv1_1")(
+            ConvBlock(64, 3, 2, bn, name="conv1")(out_conv0, training),
+            training)
+        out_conv2 = ConvBlock(128, 3, 1, bn, name="conv2_1")(
+            ConvBlock(128, 3, 2, bn, name="conv2")(out_conv1, training),
+            training)
+        out_conv3 = ConvBlock(256, 3, 1, bn, name="conv3_1")(
+            ConvBlock(256, 3, 2, bn, name="conv3")(out_conv2, training),
+            training)
+        out_conv4 = ConvBlock(512, 3, 1, bn, name="conv4_1")(
+            ConvBlock(512, 3, 2, bn, name="conv4")(out_conv3, training),
+            training)
+        out_conv5 = ConvBlock(512, 3, 1, bn, name="conv5_1")(
+            ConvBlock(512, 3, 2, bn, name="conv5")(out_conv4, training),
+            training)
+        out_conv6 = ConvBlock(1024, 3, 1, bn, name="conv6_1")(
+            ConvBlock(1024, 3, 2, bn, name="conv6")(out_conv5, training),
+            training)
+        flow6 = PredictFlow(name="predict_flow6")(out_conv6)
+        flow6_up = nn.ConvTranspose(2, (4, 4), strides=(2, 2),
+                                    padding=((2, 2), (2, 2)),
+                                    name="upflow6")(flow6)
+        de5 = Deconv(512, name="deconv5")(out_conv6)
+        concat5 = jnp.concatenate([out_conv5, de5, flow6_up], axis=-1)
+        flow5, concat4 = _RefineSD(512, 256, bn, name="refine4")(concat5,
+                                                                 out_conv4)
+        flow4, concat3 = _RefineSD(256, 128, bn, name="refine3")(concat4,
+                                                                 out_conv3)
+        flow3, concat2 = _RefineSD(128, 64, bn, name="refine2")(concat3,
+                                                                out_conv2)
+        inter2 = ConvBlock(64, 3, 1, bn, activate=False, name="inter_conv2")(
+            concat2)
+        flow2 = PredictFlow(name="predict_flow2")(inter2)
+        return flow2, flow3, flow4, flow5, flow6
+
+
+class FlowNetFusion(nn.Module):
+    """(ref: flownet_fusion.py:13-85)."""
+
+    use_batch_norm: bool = False
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        bn = self.use_batch_norm
+        out_conv0 = ConvBlock(64, 3, 1, bn, name="conv0")(x, training)
+        out_conv1 = ConvBlock(128, 3, 1, bn, name="conv1_1")(
+            ConvBlock(64, 3, 2, bn, name="conv1")(out_conv0, training),
+            training)
+        out_conv2 = ConvBlock(128, 3, 1, bn, name="conv2_1")(
+            ConvBlock(128, 3, 2, bn, name="conv2")(out_conv1, training),
+            training)
+        flow2 = PredictFlow(name="predict_flow2")(out_conv2)
+        flow2_up = nn.ConvTranspose(2, (4, 4), strides=(2, 2),
+                                    padding=((2, 2), (2, 2)),
+                                    name="upflow2")(flow2)
+        de1 = Deconv(32, name="deconv1")(out_conv2)
+        concat1 = jnp.concatenate([out_conv1, de1, flow2_up], axis=-1)
+        inter1 = ConvBlock(32, 3, 1, bn, activate=False, name="inter_conv1")(
+            concat1)
+        flow1 = PredictFlow(name="predict_flow1")(inter1)
+        flow1_up = nn.ConvTranspose(2, (4, 4), strides=(2, 2),
+                                    padding=((2, 2), (2, 2)),
+                                    name="upflow1")(flow1)
+        de0 = Deconv(16, name="deconv0")(concat1)
+        concat0 = jnp.concatenate([out_conv0, de0, flow1_up], axis=-1)
+        inter0 = ConvBlock(16, 3, 1, bn, activate=False, name="inter_conv0")(
+            concat0)
+        return PredictFlow(name="predict_flow0")(inter0)
+
+
+def _up4(x, method="bilinear"):
+    b, h, w, c = x.shape
+    return jax.image.resize(x, (b, 4 * h, 4 * w, c), method=method)
+
+
+class FlowNet2(nn.Module):
+    """The full cascade (ref: models.py:20-173). Input: two images
+    stacked on a time axis (B, 2, H, W, 3) in [0, rgb_max]; output
+    pixel-unit flow (B, H, W, 2)."""
+
+    rgb_max: float = 1.0
+    div_flow: float = 20.0
+    use_batch_norm: bool = False
+
+    @nn.compact
+    def __call__(self, inputs, training=False):
+        rgb_mean = jnp.mean(inputs, axis=(1, 2, 3), keepdims=True)
+        x = (inputs - rgb_mean) / self.rgb_max
+        x1, x2 = x[:, 0], x[:, 1]
+        x = jnp.concatenate([x1, x2], axis=-1)
+
+        flownetc_flow2 = FlowNetC(self.use_batch_norm, name="flownetc")(
+            x, training)[0]
+        flownetc_flow = _up4(flownetc_flow2 * self.div_flow)
+        resampled_img1 = resample2d(x2, flownetc_flow)
+        norm_diff_img0 = channelnorm(x1 - resampled_img1)
+        concat1 = jnp.concatenate(
+            [x, resampled_img1, flownetc_flow / self.div_flow,
+             norm_diff_img0], axis=-1)
+
+        flownets1_flow2 = FlowNetS(12, self.use_batch_norm,
+                                   name="flownets_1")(concat1, training)[0]
+        flownets1_flow = _up4(flownets1_flow2 * self.div_flow)
+        resampled_img1 = resample2d(x2, flownets1_flow)
+        norm_diff_img0 = channelnorm(x1 - resampled_img1)
+        concat2 = jnp.concatenate(
+            [x, resampled_img1, flownets1_flow / self.div_flow,
+             norm_diff_img0], axis=-1)
+
+        flownets2_flow2 = FlowNetS(12, self.use_batch_norm,
+                                   name="flownets_2")(concat2, training)[0]
+        flownets2_flow = _up4(flownets2_flow2 * self.div_flow,
+                              method="nearest")
+        norm_flownets2_flow = channelnorm(flownets2_flow)
+        diff_flownets2_img1 = channelnorm(
+            x1 - resample2d(x2, flownets2_flow))
+
+        flownetsd_flow2 = FlowNetSD(self.use_batch_norm, name="flownets_d")(
+            x, training)[0]
+        flownetsd_flow = _up4(flownetsd_flow2 / self.div_flow,
+                              method="nearest")
+        norm_flownetsd_flow = channelnorm(flownetsd_flow)
+        diff_flownetsd_img1 = channelnorm(
+            x1 - resample2d(x2, flownetsd_flow))
+
+        concat3 = jnp.concatenate(
+            [x1, flownetsd_flow, flownets2_flow, norm_flownetsd_flow,
+             norm_flownets2_flow, diff_flownetsd_img1,
+             diff_flownets2_img1], axis=-1)
+        return FlowNetFusion(self.use_batch_norm, name="flownetfusion")(
+            concat3, training)

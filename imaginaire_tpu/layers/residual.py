@@ -52,6 +52,9 @@ class _BaseResBlock(nn.Module):
     activation_norm_type: str = ""
     activation_norm_params: Optional[dict] = None
     skip_activation_norm: bool = True
+    # apply the block nonlinearity in the learned shortcut too
+    # (ref: residual.py:98-106, FUNIT's decoder turns this on)
+    skip_nonlinearity: bool = False
     nonlinearity: str = "leakyrelu"
     apply_noise: bool = False
     hidden_channels_equal_out_channels: bool = False
@@ -123,10 +126,14 @@ class _BaseResBlock(nn.Module):
             sc_common["apply_noise"] = False
             if not self.skip_activation_norm:
                 sc_common["activation_norm_type"] = ""
-            sc_common["nonlinearity"] = ""
+            # the shortcut uses the first half of the order string with the
+            # block nonlinearity only when skip_nonlinearity is set
+            # (ref: residual.py:98-108, conv order[0:3])
+            sc_common["nonlinearity"] = (self.nonlinearity
+                                         if self.skip_nonlinearity else "")
             xs = conv_cls(
-                out_channels=self.out_channels, stride=self.stride, order="CN",
-                bias=bias_s, name="conv_s", **sc_common
+                out_channels=self.out_channels, stride=self.stride,
+                order=order0, bias=bias_s, name="conv_s", **sc_common
             )(xs, *cond_inputs, training=training)
         xs = self._scale_down(xs)
         return xs + dx

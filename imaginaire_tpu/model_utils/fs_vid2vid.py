@@ -1,0 +1,141 @@
+"""vid2vid / fs-vid2vid model utilities
+(ref: imaginaire/model_utils/fs_vid2vid.py).
+
+TPU-first: ``resample`` reuses the framework's resample2d op (bilinear
+border-clamped warp with a custom VJP and Pallas path) instead of a
+grid_sample gather; frame buffers are NTHWC with time at axis 1.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from imaginaire_tpu.ops.resample2d import resample2d
+
+
+def resample(image, flow):
+    """Warp ``image`` by pixel-unit ``flow`` (ref: fs_vid2vid.py:14-39).
+
+    image: (B, H, W, C); flow: (B, H, W, 2) in pixels (x, y).
+    """
+    return resample2d(image, flow)
+
+
+def pick_image(images, idx):
+    """Select one of N reference images per batch entry
+    (ref: fs_vid2vid.py:80-97). images: (B, N, H, W, C) or list thereof."""
+    if isinstance(images, list):
+        return [pick_image(r, idx) for r in images]
+    if images is None:
+        return None
+    if idx is None:
+        return images[:, 0]
+    if isinstance(idx, int):
+        return images[:, idx]
+    idx = idx.reshape(-1).astype(jnp.int32)
+    return jax.vmap(lambda imgs, i: imgs[i])(images, idx)
+
+
+def concat_frames(prev, now, n_frames):
+    """Append current frame, keeping the latest n_frames
+    (ref: fs_vid2vid.py:405-421). prev: (B, T, H, W, C) or None;
+    now: (B, H, W, C)."""
+    now = now[:, None]
+    if prev is None:
+        return now
+    if prev.shape[1] == n_frames:
+        prev = prev[:, 1:]
+    return jnp.concatenate([prev, now], axis=1)
+
+
+def detach(tree):
+    """stop_gradient across a pytree of generator outputs
+    (ref: fs_vid2vid.py:374-388); passes None leaves through."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.stop_gradient(x) if x is not None else None, tree)
+
+
+def get_fg_mask(densepose_map, has_fg):
+    """Foreground mask from a densepose channel (ref: fs_vid2vid.py:436-463):
+    everything but the background class, lightly blurred."""
+    if not has_fg or densepose_map is None:
+        return 1.0
+    if densepose_map.ndim == 5:
+        densepose_map = densepose_map[:, 0]
+    # first 3 channels encode the part segmentation in [-1, 1]; fg where
+    # any part channel is above background (ref thresholds 2/25 grid)
+    mask = (densepose_map[..., 2:3] > -1.0 + 2.0 / 24.0).astype(jnp.float32)
+    # 3x3 box blur smooths the boundary like the ref's avg_pool trick
+    kernel = jnp.ones((3, 3, 1, 1), jnp.float32) / 9.0
+    mask = jax.lax.conv_general_dilated(
+        mask, kernel, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return jnp.clip(mask, 0.0, 1.0)
+
+
+def skip_stride_span(tD, scale):
+    """(t_step, t_span) of temporal scale s: neighbor stride tD**s and the
+    frame distance a tD-frame stack covers (ref: fs_vid2vid.py:242-247).
+    Single source of the stride math for get_skipped_frames and the
+    vid2vid trainer's ring-buffer slicing."""
+    t_step = tD ** scale
+    return t_step, t_step * (tD - 1)
+
+
+def get_skipped_frames(all_frames, frame, t_scales, tD):
+    """Temporal-pyramid frame stacks (ref: discriminators/fs_vid2vid.py:225-256).
+
+    all_frames: (B, T, H, W, C) past buffer or None; frame: (B, 1, H, W, C).
+    Returns (new_buffer, [per-scale (B, tD, H, W, C) stack or None]).
+    Host-side bookkeeping between jitted steps: shapes depend only on how
+    many frames have been seen, so the jit variants are bounded by
+    max_num_prev_frames.
+    """
+    all_frames = (frame if all_frames is None else
+                  jnp.concatenate([jax.lax.stop_gradient(all_frames), frame],
+                                  axis=1))
+    skipped = [None] * t_scales
+    for s in range(t_scales):
+        t_step, t_span = skip_stride_span(tD, s)
+        if all_frames.shape[1] > t_span:
+            skipped[s] = all_frames[:, -(t_span + 1)::t_step]
+    max_num_prev_frames = (tD ** (t_scales - 1)) * (tD - 1)
+    if all_frames.shape[1] > max_num_prev_frames:
+        all_frames = all_frames[:, -max_num_prev_frames:]
+    return all_frames, skipped
+
+
+def get_all_skipped_frames(past_frames, new_frames, t_scales, tD):
+    """(ref: discriminators/fs_vid2vid.py:199-222)."""
+    new_past, skipped = [], []
+    for past, new in zip(past_frames, new_frames):
+        sk = None
+        if t_scales > 0:
+            past, sk = get_skipped_frames(past, new[:, None], t_scales, tD)
+        new_past.append(past)
+        skipped.append(sk)
+    return new_past, skipped
+
+
+def extract_valid_pose_labels(pose_map, pose_type, remove_face_labels,
+                              do_remove=True):
+    """Slice pose label channels by pose_type
+    (ref: fs_vid2vid.py:522-576): densepose occupies the first 3
+    channels, openpose the rest; 'open' keeps only openpose; face labels
+    (densepose part channels) can be zeroed for ablation."""
+    if pose_map is None:
+        return pose_map
+    if isinstance(pose_map, list):
+        return [extract_valid_pose_labels(p, pose_type, remove_face_labels,
+                                          do_remove) for p in pose_map]
+    if pose_type == "open":
+        pose_map = pose_map[..., 3:]
+    elif remove_face_labels and do_remove:
+        densepose = pose_map[..., :3]
+        openpose = pose_map[..., 3:]
+        # face region = part index ~23/24 in the normalized part channel
+        face = (densepose[..., 2:3] > 0.4) & (densepose[..., 2:3] < 0.6)
+        densepose = jnp.where(face, -1.0, densepose)
+        pose_map = jnp.concatenate([densepose, openpose], axis=-1)
+    return pose_map

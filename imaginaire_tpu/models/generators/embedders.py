@@ -1,0 +1,78 @@
+"""Label/image embedding pyramid for the vid2vid family
+(ref: imaginaire/generators/fs_vid2vid.py:1072-1176, LabelEmbedder).
+
+Embeds an input map and returns features at every scale; the vid2vid
+main branch feeds scale i to the SPADE layers at resolution i. Archs:
+'encoder' (downsample trail), 'encoderdecoder' (use decoder outputs),
+'unet' (decoder with skip concats). Hyper layers accept per-sample conv
+weights predicted by fs-vid2vid's weight generator.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from imaginaire_tpu.config import as_attrdict, cfg_get
+from imaginaire_tpu.layers import HyperConv2dBlock
+from imaginaire_tpu.utils.misc import upsample_2x
+
+
+class LabelEmbedder(nn.Module):
+    emb_cfg: Any
+    num_input_channels: int
+    num_hyper_layers: int = 0
+
+    @nn.compact
+    def __call__(self, x, weights=None, training=False):
+        if x is None:
+            return None
+        cfg = as_attrdict(self.emb_cfg)
+        num_filters = cfg_get(cfg, "num_filters", 32)
+        max_num_filters = cfg_get(cfg, "max_num_filters", 1024)
+        arch = cfg_get(cfg, "arch", "encoderdecoder")
+        num_downsamples = cfg_get(cfg, "num_downsamples", 5)
+        kernel_size = cfg_get(cfg, "kernel_size", 3)
+        wn = cfg_get(cfg, "weight_norm_type", "spectral")
+        an = cfg_get(cfg, "activation_norm_type", "none")
+        unet = "unet" in arch
+        has_decoder = "decoder" in arch or unet
+        num_hyper = (num_downsamples if self.num_hyper_layers == -1
+                     else self.num_hyper_layers)
+
+        def block(ch, name, stride=1, an_type=an):
+            return HyperConv2dBlock(
+                ch, kernel_size=kernel_size, stride=stride,
+                padding=kernel_size // 2, weight_norm_type=wn,
+                activation_norm_type=an_type, nonlinearity="leakyrelu",
+                name=name)
+
+        ch = [min(max_num_filters, num_filters * (2 ** i))
+              for i in range(num_downsamples + 1)]
+        output = [block(num_filters, "conv_first", an_type="none")(
+            x, training=training)]
+        for i in range(num_downsamples):
+            hyper = (i < num_hyper) and not has_decoder
+            w = (weights[i] if hyper and weights is not None else None)
+            output.append(block(ch[i + 1], f"down_{i}", stride=2)(
+                output[-1], conv_weights=w, training=training))
+
+        if not has_decoder:
+            return output
+
+        # decoder trail (ref: fs_vid2vid.py:1156-1176)
+        if not unet:
+            output = [output[-1]]
+        for i in reversed(range(num_downsamples)):
+            input_i = output[-1]
+            if unet and i != num_downsamples - 1:
+                input_i = jnp.concatenate([input_i, output[i + 1]], axis=-1)
+            input_i = upsample_2x(input_i)
+            w = (weights[i] if i < num_hyper and weights is not None else None)
+            output.append(block(ch[i], f"up_{i}")(
+                input_i, conv_weights=w, training=training))
+        if unet:
+            output = output[num_downsamples:]
+        return output[::-1]

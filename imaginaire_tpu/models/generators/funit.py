@@ -76,6 +76,7 @@ class FUNITDecoder(nn.Module):
         for i in range(self.num_upsamples):
             x = UpRes2dBlock(nf // 2, kernel_size=5, padding=2,
                              hidden_channels_equal_out_channels=True,
+                             skip_nonlinearity=True,
                              name=f"up_{i}", **adain)(x, style,
                                                       training=training)
             nf //= 2

@@ -434,9 +434,12 @@ class BaseTrainer:
                 rngs={"noise": jax.random.PRNGKey(it)},
                 method=self.net_G.inference, **inference_args)
             keys = data.get("key", [f"{it:06d}_{i}" for i in range(images.shape[0])])
+            if isinstance(keys, (str, bytes)):
+                keys = [keys]
             for img, name in zip(np.asarray(images), keys):
-                save_image_grid([tensor2im(img)],
-                                os.path.join(output_dir, f"{name}.jpg"))
+                path = os.path.join(output_dir, f"{name}.jpg")
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                save_image_grid([tensor2im(img)], path)
 
     def save_image(self, path, data):
         """Visualization snapshot (ref: base.py:445-465)."""

@@ -1,0 +1,456 @@
+"""vid2vid trainer (ref: imaginaire/trainers/vid2vid.py:30-766).
+
+Training is an interleaved per-frame rollout: for each frame t of the
+sequence, one discriminator update then one generator update, feeding
+the generator its own (detached) previous outputs
+(ref: vid2vid.py:238-288). The sequence-length curriculum starts at a
+single frame and doubles every ``num_epochs_temporal_step`` epochs
+(ref: vid2vid.py:162-204).
+
+TPU-first: each (prev-frame-count, active-temporal-scale) combination
+is one jitted step program; jax.jit's structure cache handles the
+variants (bounded: prev counts ≤ num_frames_G-1, scale activations ≤
+num_scales). Temporal-discriminator inputs come from host-threaded
+device ring buffers sliced with static strides (the reference's
+get_skipped_frames bookkeeping, discriminators/fs_vid2vid.py:225-256) —
+no dynamic shapes inside any step.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from imaginaire_tpu.config import cfg_get
+from imaginaire_tpu.losses import PerceptualLoss, feature_matching_loss, gan_loss
+from imaginaire_tpu.losses.flow import masked_l1_loss
+from imaginaire_tpu.model_utils.fs_vid2vid import concat_frames, skip_stride_span
+from imaginaire_tpu.trainers.base import MUTABLE, BaseTrainer
+from imaginaire_tpu.utils.misc import numeric_only, to_device
+from imaginaire_tpu.utils.model_average import ema_init, ema_update
+
+
+class Trainer(BaseTrainer):
+    def __init__(self, cfg, *args, **kwargs):
+        super().__init__(cfg, *args, **kwargs)
+        self.num_frames_G = cfg_get(cfg.data, "num_frames_G", 3)
+        self.num_frames_D = cfg_get(cfg.data, "num_frames_D", 3)
+        self.has_fg = cfg_get(cfg.data, "has_foreground", False)
+        self.sequence_length = 1
+        self.sequence_length_max = cfg_get(
+            cfg_get(cfg.data, "train", {}) or {}, "max_sequence_length", 16)
+        if self.train_data_loader is not None:
+            ds = getattr(self.train_data_loader, "dataset", None)
+            if ds is not None and hasattr(ds, "sequence_length_max"):
+                self.sequence_length_max = min(self.sequence_length_max,
+                                               ds.sequence_length_max)
+        self._jit_vid_dis = jax.jit(self._vid_dis_step_fn, donate_argnums=0)
+        self._jit_vid_gen = jax.jit(self._vid_gen_step_fn, donate_argnums=0)
+
+    # ---------------------------------------------------------------- loss
+
+    def _init_loss(self, cfg):
+        """(ref: trainers/vid2vid.py:89-157)."""
+        tcfg = cfg.trainer
+        lw = tcfg.loss_weight
+        self.gan_mode = cfg_get(tcfg, "gan_mode", "hinge")
+        self.weights["GAN"] = lw.gan
+        self.weights["FeatureMatching"] = lw.feature_matching
+        self.perceptual = None
+        if cfg_get(tcfg, "perceptual_loss", None) is not None:
+            p = tcfg.perceptual_loss
+            self.perceptual = PerceptualLoss(
+                network=p.mode, layers=list(p.layers),
+                weights=list(cfg_get(p, "weights", None) or []) or None,
+                weights_path=cfg_get(p, "weights_path", None),
+                allow_random_init=cfg_get(p, "allow_random_init", False))
+            self.weights["Perceptual"] = lw.perceptual
+        if cfg_get(lw, "L1", 0) > 0:
+            self.weights["L1"] = lw.L1
+        self.use_flow = cfg_get(cfg.gen, "flow", None) is not None
+        if self.use_flow:
+            # fork semantics: masked L1 between final and warped frames
+            # (ref: trainers/vid2vid.py:148-152,517-519; full FlowLoss with
+            # a FlowNet2 teacher plugs in via losses/flow.FlowLoss)
+            self.weights["Flow"] = lw.flow
+        self.num_temporal_scales = cfg_get(
+            cfg_get(cfg.dis, "temporal", {}) or {}, "num_scales", 0)
+        for s in range(self.num_temporal_scales):
+            self.weights[f"GAN_T{s}"] = cfg_get(lw, "temporal_gan", 0)
+            self.weights[f"FeatureMatching_T{s}"] = lw.feature_matching
+
+    def init_loss_params(self, key):
+        if self.perceptual is None:
+            return {}
+        return {"perceptual": self.perceptual.init_params(key)}
+
+    # --------------------------------------------------------------- state
+
+    def _frame0(self, data):
+        label = data["label"]
+        images = data["images"]
+        if label.ndim == 5:
+            label = label[:, 0]
+        if images.ndim == 5:
+            images = images[:, 0]
+        return {"label": label, "image": images}
+
+    def init_state(self, key, data):
+        """All generator submodules (temporal path included) and all
+        temporal discriminator scales materialize here — the curriculum
+        only flips static flags later."""
+        data = to_device(numeric_only(dict(data)))
+        data_t = self._frame0(data)
+        k_g, k_d, k_loss, k_noise, k_rg, k_rd = jax.random.split(key, 6)
+        vars_G = dict(jax.jit(
+            lambda rngs, d: self.net_G.init(rngs, d, training=True,
+                                            init_all=True))(
+            {"params": k_g, "noise": k_noise}, data_t))
+        state: Dict[str, Any] = {
+            "vars_G": vars_G,
+            "opt_G": self.tx_G.init(vars_G["params"]),
+            "step": jnp.zeros((), jnp.int32),
+            "rng_G": k_rg,
+            "rng_D": k_rd,
+            "loss_params": self.init_loss_params(k_loss),
+        }
+        b, h, w, _ = data_t["label"].shape
+        c_img = data_t["image"].shape[-1]
+        fake_out = {"fake_images": jnp.zeros_like(data_t["image"]),
+                    "fake_raw_images": jnp.zeros_like(data_t["image"])}
+        tD = self.num_frames_D
+        stacks = {f"s{s}": (jnp.zeros((b, tD - 1, h, w, c_img)),
+                            jnp.zeros((b, tD - 1, h, w, c_img)))
+                  for s in range(self.num_temporal_scales)}
+        vars_D = dict(jax.jit(
+            lambda rngs, d, f, st: self.net_D.init(
+                rngs, d, f, past_stacks=st, training=True))(
+            {"params": k_d, "dropout": k_d}, data_t, fake_out,
+            self._stacks_list(stacks)))
+        state["vars_D"] = vars_D
+        state["opt_D"] = self.tx_D.init(vars_D["params"])
+        state["step_D"] = jnp.zeros((), jnp.int32)
+        if self.model_average:
+            state["ema_G"] = ema_init(
+                vars_G["params"], vars_G.get("spectral"),
+                remove_sn=self.model_average_remove_sn)
+            state["num_ema_updates"] = jnp.zeros((), jnp.int32)
+        self.state = state
+        return state
+
+    def _stacks_list(self, stacks):
+        """dict {'s0': (real, fake)} -> list indexed by scale, None when
+        absent (the discriminator's past_stacks contract)."""
+        return [stacks.get(f"s{s}") for s in range(self.num_temporal_scales)]
+
+    # ------------------------------------------------------------ forwards
+
+    def _apply_G(self, vars_G, data_t, rng, training):
+        return self.net_G.apply(vars_G, data_t, training=training,
+                                rngs={"noise": rng}, mutable=list(MUTABLE))
+
+    def _apply_D(self, vars_D, data_t, out, stacks, training, mutable=False):
+        kwargs = dict(past_stacks=self._stacks_list(stacks),
+                      training=training)
+        if mutable:
+            return self.net_D.apply(vars_D, data_t, out,
+                                    mutable=list(MUTABLE), **kwargs)
+        return self.net_D.apply(vars_D, data_t, out, **kwargs)
+
+    def _gan_fm_losses(self, d_out_part, dis_update):
+        """(ref: trainers/vid2vid.py:609-635)."""
+        fake = d_out_part["pred_fake"]
+        real = d_out_part["pred_real"]
+        if dis_update:
+            gan = 0.5 * (
+                gan_loss(fake["outputs"], False, self.gan_mode, True)
+                + gan_loss(real["outputs"], True, self.gan_mode, True))
+            return gan, None
+        gan = gan_loss(fake["outputs"], True, self.gan_mode, False)
+        fm = feature_matching_loss(fake["features"], real["features"])
+        return gan, fm
+
+    def _split_data_t(self, data):
+        data = dict(data)
+        stacks = data.pop("past_stacks", {})
+        return data, stacks
+
+    def gen_forward(self, vars_G, vars_D, loss_params, data, rng,
+                    training=True):
+        """Per-frame G losses (ref: trainers/vid2vid.py:469-553)."""
+        data_t, stacks = self._split_data_t(data)
+        out, new_mut = self._apply_G(vars_G, data_t, rng, training)
+        d_out = self._apply_D(vars_D, data_t, out, stacks, training)
+
+        losses = {}
+        losses["GAN"], losses["FeatureMatching"] = self._gan_fm_losses(
+            d_out["indv"], dis_update=False)
+        if self.perceptual is not None:
+            losses["Perceptual"] = self.perceptual(
+                loss_params["perceptual"], out["fake_images"],
+                data_t["image"])
+        if "L1" in self.weights:
+            losses["L1"] = jnp.mean(jnp.abs(out["fake_images"]
+                                            - data_t["image"]))
+        if "raw" in d_out:
+            raw_gan, raw_fm = self._gan_fm_losses(d_out["raw"],
+                                                  dis_update=False)
+            losses["GAN"] = losses["GAN"] + raw_gan
+            losses["FeatureMatching"] = losses["FeatureMatching"] + raw_fm
+            if self.perceptual is not None:
+                from imaginaire_tpu.model_utils.fs_vid2vid import get_fg_mask
+
+                fg = get_fg_mask(data_t["label"], self.has_fg)
+                losses["Perceptual"] = losses["Perceptual"] + self.perceptual(
+                    loss_params["perceptual"],
+                    out["fake_raw_images"] * fg, data_t["image"] * fg)
+        if self.use_flow and out.get("warped_images") is not None:
+            # stop-grad the occlusion mask: it weights its own loss, and a
+            # learnable weight has a degenerate mask->0 optimum
+            losses["Flow"] = masked_l1_loss(
+                out["fake_images"], out["warped_images"],
+                jax.lax.stop_gradient(out["fake_occlusion_masks"]))
+        for s in range(self.num_temporal_scales):
+            if f"temporal_{s}" in d_out:
+                gan_t, fm_t = self._gan_fm_losses(d_out[f"temporal_{s}"],
+                                                  dis_update=False)
+                losses[f"GAN_T{s}"] = gan_t
+                losses[f"FeatureMatching_T{s}"] = fm_t
+        return losses, new_mut, out
+
+    def dis_forward(self, vars_G, vars_D, loss_params, data, rng,
+                    training=True):
+        """Per-frame D losses (ref: trainers/vid2vid.py:555-599)."""
+        data_t, stacks = self._split_data_t(data)
+        out, _ = self._apply_G(vars_G, data_t, rng, training)
+        out = jax.lax.stop_gradient(
+            {k: v for k, v in out.items() if v is not None})
+        d_out, new_mut_D = self._apply_D(vars_D, data_t, out, stacks,
+                                         training, mutable=True)
+        losses = {}
+        losses["GAN"], _ = self._gan_fm_losses(d_out["indv"], dis_update=True)
+        if "raw" in d_out:
+            raw_gan, _ = self._gan_fm_losses(d_out["raw"], dis_update=True)
+            losses["GAN"] = losses["GAN"] + raw_gan
+        for s in range(self.num_temporal_scales):
+            if f"temporal_{s}" in d_out:
+                gan_t, _ = self._gan_fm_losses(d_out[f"temporal_{s}"],
+                                               dis_update=True)
+                losses[f"GAN_T{s}"] = gan_t
+        return losses, new_mut_D
+
+    # --------------------------------------------------------- jitted steps
+
+    def _vid_gen_step_fn(self, state, data):
+        rng = jax.random.fold_in(state["rng_G"], state["step"])
+
+        def loss_fn(params_G):
+            vars_G = dict(state["vars_G"],
+                          params=self._to_compute_dtype(params_G))
+            losses, new_mut, out = self.gen_forward(
+                vars_G, self._to_compute_dtype(state["vars_D"]),
+                state["loss_params"], self._to_compute_dtype(data), rng)
+            losses = {k: v.astype(jnp.float32) for k, v in losses.items()}
+            total = self._total(losses)
+            return total, (dict(losses, total=total), new_mut,
+                           out["fake_images"])
+
+        (_, (losses, new_mut, fake)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state["vars_G"]["params"])
+        if self.clip_grad_norm_G:
+            grads, _ = optax.clip_by_global_norm(
+                self.clip_grad_norm_G).update(grads, optax.EmptyState())
+        updates, new_opt = self.tx_G.update(
+            grads, state["opt_G"], state["vars_G"]["params"])
+        new_params = optax.apply_updates(state["vars_G"]["params"], updates)
+        new_vars_G = dict(state["vars_G"], params=new_params, **new_mut)
+        state = dict(state, vars_G=new_vars_G, opt_G=new_opt,
+                     step=state["step"] + 1)
+        if self.model_average:
+            n = state["num_ema_updates"] + 1
+            state["ema_G"] = ema_update(
+                state["ema_G"], new_params, n,
+                beta=self.model_average_beta,
+                start_iteration=self.model_average_start,
+                spectral=new_vars_G.get("spectral"),
+                remove_sn=self.model_average_remove_sn)
+            state["num_ema_updates"] = n
+        return state, losses, jax.lax.stop_gradient(fake)
+
+    def _vid_dis_step_fn(self, state, data):
+        rng = jax.random.fold_in(state["rng_D"], state["step_D"])
+
+        def loss_fn(params_D):
+            vars_D = dict(state["vars_D"],
+                          params=self._to_compute_dtype(params_D))
+            losses, new_mut = self.dis_forward(
+                self._to_compute_dtype(state["vars_G"]), vars_D,
+                state["loss_params"], self._to_compute_dtype(data), rng)
+            losses = {k: v.astype(jnp.float32) for k, v in losses.items()}
+            total = self._total(losses)
+            return total, (dict(losses, total=total), new_mut)
+
+        (_, (losses, new_mut)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state["vars_D"]["params"])
+        if self.clip_grad_norm_D:
+            grads, _ = optax.clip_by_global_norm(
+                self.clip_grad_norm_D).update(grads, optax.EmptyState())
+        updates, new_opt = self.tx_D.update(
+            grads, state["opt_D"], state["vars_D"]["params"])
+        new_params = optax.apply_updates(state["vars_D"]["params"], updates)
+        state = dict(state,
+                     vars_D=dict(state["vars_D"], params=new_params,
+                                 **new_mut),
+                     opt_D=new_opt, step_D=state["step_D"] + 1)
+        return state, losses
+
+    # ------------------------------------------------------------- rollout
+
+    def _get_data_t(self, data, t, prev_labels, prev_images):
+        """(ref: trainers/vid2vid.py:637-668)."""
+        label = data["label"][:, t] if data["label"].ndim == 5 \
+            else data["label"]
+        image = data["images"][:, t] if data["images"].ndim == 5 \
+            else data["images"]
+        data_t = {"label": label, "image": image}
+        if prev_images is not None:
+            data_t["prev_labels"] = prev_labels
+            data_t["prev_images"] = prev_images
+        return data_t
+
+    def _past_stacks(self, past_real, past_fake):
+        """Per-scale strided past stacks from the ring buffers
+        (ref: discriminators/fs_vid2vid.py:225-256); the current frame is
+        appended inside the discriminator so G gradients reach it."""
+        stacks = {}
+        if past_real is None:
+            return stacks
+        tD = self.num_frames_D
+        L = past_real.shape[1]
+        for s in range(self.num_temporal_scales):
+            # buffer here EXCLUDES the current frame (the discriminator
+            # appends it so G gradients reach it), hence >= t_span where
+            # get_skipped_frames (current included) uses > t_span
+            t_step, t_span = skip_stride_span(tD, s)
+            if L >= t_span:
+                stacks[f"s{s}"] = (past_real[:, -t_span::t_step],
+                                   past_fake[:, -t_span::t_step])
+        return stacks
+
+    def gen_update(self, data):
+        """Interleaved per-frame D/G rollout (ref: vid2vid.py:238-288)."""
+        data = numeric_only(data)
+        seq_len = (data["images"].shape[1] if data["images"].ndim == 5
+                   else 1)
+        tD = self.num_frames_D
+        max_prev = (tD ** max(self.num_temporal_scales - 1, 0)) * (tD - 1)
+        prev_labels = prev_images = None
+        past_real = past_fake = None
+        t0 = time.time() if self.speed_benchmark else None
+        d_hist, g_hist = [], []
+        for t in range(seq_len):
+            data_t = self._get_data_t(data, t, prev_labels, prev_images)
+            data_t["past_stacks"] = self._past_stacks(past_real, past_fake)
+            self.state, d_losses = self._jit_vid_dis(self.state, data_t)
+            self.state, g_losses, fake = self._jit_vid_gen(self.state, data_t)
+            d_hist.append(d_losses)
+            g_hist.append(g_losses)
+            prev_labels = concat_frames(prev_labels, data_t["label"],
+                                        self.num_frames_G - 1)
+            prev_images = concat_frames(prev_images, fake,
+                                        self.num_frames_G - 1)
+            if self.num_temporal_scales > 0:
+                past_real = concat_frames(past_real, data_t["image"],
+                                          max_prev)
+                past_fake = concat_frames(past_fake, fake, max_prev)
+        if self.speed_benchmark:
+            jax.block_until_ready(self.state["vars_G"]["params"])
+            self._meter("time/gen_step").write(time.time() - t0)
+
+        def mean_losses(hist):
+            keys = set().union(*(h.keys() for h in hist))
+            return {k: sum(h[k] for h in hist if k in h)
+                    / sum(1 for h in hist if k in h) for k in keys}
+
+        d_losses, g_losses = mean_losses(d_hist), mean_losses(g_hist)
+        self._log_losses("dis_update", d_losses)
+        self._log_losses("gen_update", g_losses)
+        return g_losses
+
+    def dis_update(self, data):
+        """D updates happen inside gen_update's rollout
+        (ref: trainers/vid2vid.py:290-296)."""
+        return None
+
+    # ----------------------------------------------------------- curriculum
+
+    def _start_of_epoch(self, current_epoch):
+        """Sequence-length curriculum (ref: trainers/vid2vid.py:162-204)."""
+        cfg = self.cfg
+        dataset = getattr(self.train_data_loader, "dataset", None)
+        single_frame_epoch = cfg_get(cfg, "single_frame_epoch", 0)
+        if current_epoch < single_frame_epoch:
+            if dataset is not None:
+                dataset.set_sequence_length(1)
+            self.sequence_length = 1
+            return
+        if current_epoch == single_frame_epoch:
+            self.init_temporal_network()
+        temp_epoch = current_epoch - single_frame_epoch
+        if temp_epoch > 0:
+            initial = cfg_get(cfg_get(cfg.data, "train", {}) or {},
+                              "initial_sequence_length", 4)
+            step = cfg_get(cfg, "num_epochs_temporal_step", 1)
+            seq = min(initial * (2 ** (temp_epoch // step)),
+                      self.sequence_length_max)
+            if seq > self.sequence_length:
+                self.sequence_length = seq
+                if dataset is not None:
+                    dataset.set_sequence_length(seq)
+                print(f"------- Updating sequence length to {seq} -------")
+
+    def init_temporal_network(self):
+        """(ref: trainers/vid2vid.py:194-204). Params already exist (built
+        at init); only the data curriculum changes."""
+        self.sequence_length = cfg_get(
+            cfg_get(self.cfg.data, "train", {}) or {},
+            "initial_sequence_length", 4)
+        self.sequence_length = min(self.sequence_length,
+                                   self.sequence_length_max)
+        dataset = getattr(self.train_data_loader, "dataset", None)
+        if dataset is not None:
+            dataset.set_sequence_length(self.sequence_length)
+        print(f"------ Now start training {self.sequence_length} frames "
+              "-------")
+
+    # -------------------------------------------------------- visualization
+
+    def _get_visualizations(self, data):
+        """Rollout the sequence with the inference params
+        (ref: trainers/vid2vid.py:672-716)."""
+        data = to_device(numeric_only(dict(data)))
+        variables = self.inference_params()
+        seq_len = (data["images"].shape[1] if data["images"].ndim == 5
+                   else 1)
+        prev_labels = prev_images = None
+        fakes = []
+        for t in range(seq_len):
+            data_t = self._get_data_t(data, t, prev_labels, prev_images)
+            out, _ = self._apply_G(variables, data_t, jax.random.PRNGKey(0),
+                                   training=False)
+            fake = out["fake_images"]
+            fakes.append(fake)
+            prev_labels = concat_frames(prev_labels, data_t["label"],
+                                        self.num_frames_G - 1)
+            prev_images = concat_frames(prev_images, fake,
+                                        self.num_frames_G - 1)
+        label = data["label"][:, -1] if data["label"].ndim == 5 \
+            else data["label"]
+        image = data["images"][:, -1] if data["images"].ndim == 5 \
+            else data["images"]
+        return [image, label[..., :3], fakes[-1]]

@@ -1,0 +1,65 @@
+"""Inference entry point (ref: inference.py:37-94).
+
+Load a config + checkpoint, run the trainer's test loop over the test
+set, and write images to --output_dir.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+from imaginaire_tpu.config import Config, cfg_get
+from imaginaire_tpu.data import get_test_dataloader
+from imaginaire_tpu.parallel.mesh import (
+    create_mesh,
+    master_only_print as print,  # noqa: A001
+    set_mesh,
+)
+from imaginaire_tpu.registry import resolve
+from imaginaire_tpu.utils.logging_utils import init_logging, make_logging_dir
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description="imaginaire-tpu inference")
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--checkpoint", default="",
+                        help="Checkpoint path; defaults to the logdir's "
+                             "latest_checkpoint pointer.")
+    parser.add_argument("--output_dir", required=True)
+    parser.add_argument("--logdir", default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    cfg = Config(args.config)
+    set_mesh(create_mesh(tuple(cfg.runtime.mesh.axes),
+                         cfg.runtime.mesh.shape))
+    date_uid, logdir = init_logging(args.config, args.logdir)
+    make_logging_dir(logdir)
+    cfg.logdir = logdir
+
+    test_loader = get_test_dataloader(cfg)
+    trainer_cls = resolve(cfg.trainer.type, "Trainer")
+    trainer = trainer_cls(cfg, val_data_loader=test_loader)
+
+    sample = next(iter(test_loader))
+    sample = trainer.start_of_iteration(sample, 0)
+    trainer.init_state(jax.random.PRNGKey(args.seed), sample)
+    loaded = trainer.load_checkpoint(args.checkpoint or None)
+    if not loaded:
+        print("WARNING: no checkpoint found; running with fresh weights.")
+
+    trainer.current_epoch = -1
+    trainer.current_iteration = -1
+    inference_args = cfg_get(cfg, "inference_args", None)
+    trainer.test(test_loader, args.output_dir,
+                 dict(inference_args) if inference_args else None)
+    print(f"Done with inference. Outputs in {args.output_dir}")
+
+
+if __name__ == "__main__":
+    main()

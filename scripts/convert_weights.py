@@ -68,8 +68,115 @@ def convert_inception(out_path):
     print(f"wrote {len(flat)} arrays to {out_path}")
 
 
+def _convtranspose(w):
+    """torch ConvTranspose2d (in,out,kh,kw) -> flax ConvTranspose kernel
+    (kh,kw,in,out) with spatial flip (verified numerically against
+    torch: flax transpose_kernel=False + 180° rotation matches)."""
+    return np.transpose(w[:, :, ::-1, ::-1], (2, 3, 0, 1))
+
+
+def _conv(w):
+    return np.transpose(w, (2, 3, 1, 0))  # OIHW -> HWIO
+
+
+def convert_flownet2(ckpt_path, out_path):
+    """flownet2.pth.tar state_dict -> imaginaire_tpu.flow tree paths.
+
+    Consumer: imaginaire_tpu/flow/flow_net.py:load_flownet2_npz. The Flax
+    decoder groups each (predict_flow, upsampled_flow, deconv) trio into a
+    refine rung; this table unrolls that mapping.
+    """
+    import torch
+
+    sd = torch.load(ckpt_path, map_location="cpu")
+    sd = sd.get("state_dict", sd)
+    sd = {k: v.numpy() for k, v in sd.items()}
+    flat = {}
+
+    def put(path, w, transpose):
+        flat[path + "/kernel"] = transpose(w)
+
+    def put_bias(path, b):
+        flat[path + "/bias"] = b
+
+    # rung tables: flax rung name -> (torch predict, torch upflow, torch deconv)
+    cs_rungs = {"refine5": ("predict_flow6", "upsampled_flow6_to_5", "deconv5"),
+                "refine4": ("predict_flow5", "upsampled_flow5_to_4", "deconv4"),
+                "refine3": ("predict_flow4", "upsampled_flow4_to_3", "deconv3"),
+                "refine2": ("predict_flow3", "upsampled_flow3_to_2", "deconv2")}
+    sd_rungs = {"refine4": ("inter_conv5", "predict_flow5",
+                            "upsampled_flow5_to_4", "deconv4"),
+                "refine3": ("inter_conv4", "predict_flow4",
+                            "upsampled_flow4_to_3", "deconv3"),
+                "refine2": ("inter_conv3", "predict_flow3",
+                            "upsampled_flow3_to_2", "deconv2")}
+
+    for key, w in sd.items():
+        net, rest = key.split(".", 1)
+        name, _, kind = rest.rpartition(".")
+        name = name.replace(".0", "")  # Sequential conv index
+        is_deconv = name.startswith("deconv") or name.startswith("upsampled")
+        trans = _convtranspose if is_deconv else _conv
+
+        path = None
+        if net in ("flownetc", "flownets_1", "flownets_2"):
+            rungs = cs_rungs
+            for rung, (pf, uf, dc) in rungs.items():
+                if name == pf:
+                    path = f"{net}/{rung}/predict/conv"
+                elif name == uf:
+                    path = f"{net}/{rung}/upflow"
+                elif name == dc:
+                    path = f"{net}/{rung}/deconv/deconv"
+                if path:
+                    break
+            if path is None:
+                if name == "predict_flow2":
+                    path = f"{net}/predict_flow2/conv"
+                else:
+                    path = f"{net}/{name}/conv"
+        elif net == "flownets_d":
+            for rung, (ic, pf, uf, dc) in sd_rungs.items():
+                if name == ic:
+                    path = f"{net}/{rung}/inter/conv"
+                elif name == pf:
+                    path = f"{net}/{rung}/predict/conv"
+                elif name == uf:
+                    path = f"{net}/{rung}/upflow"
+                elif name == dc:
+                    path = f"{net}/{rung}/deconv/deconv"
+                if path:
+                    break
+            if path is None:
+                if name == "predict_flow6":
+                    path = f"{net}/predict_flow6/conv"
+                elif name == "upsampled_flow6_to_5":
+                    path = f"{net}/upflow6"
+                elif name == "deconv5":
+                    path = f"{net}/deconv5/deconv"
+                elif name in ("predict_flow2", "inter_conv2"):
+                    path = f"{net}/{name}/conv"
+                else:
+                    path = f"{net}/{name}/conv"
+        elif net == "flownetfusion":
+            mapping = {"upsampled_flow2_to_1": "upflow2",
+                       "upsampled_flow1_to_0": "upflow1",
+                       "deconv1": "deconv1/deconv",
+                       "deconv0": "deconv0/deconv"}
+            path = f"{net}/" + mapping.get(name, f"{name}/conv")
+        else:
+            continue  # channelnorm etc.
+
+        if kind == "weight":
+            put(path, w, trans)
+        elif kind == "bias":
+            put_bias(path, w)
+    np.savez(out_path, **flat)
+    print(f"wrote {len(flat)} arrays to {out_path}")
+
+
 def main():
-    if len(sys.argv) != 3:
+    if len(sys.argv) not in (3, 4):
         print(__doc__)
         raise SystemExit(1)
     name, out = sys.argv[1], sys.argv[2]
@@ -77,6 +184,9 @@ def main():
         convert_inception(out)
     elif name in ("vgg19", "vgg16", "alexnet"):
         convert_features(name, out)
+    elif name == "flownet2":
+        convert_flownet2(sys.argv[3] if len(sys.argv) == 4 else
+                         "flownet2.pth.tar", out)
     else:
         raise SystemExit(f"unknown network {name}")
 
