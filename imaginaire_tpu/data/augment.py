@@ -10,6 +10,12 @@ config, matching the reference's ``_build_augmentation_ops``.
 Supported keys: resize_smallest_side, resize_h_w, random_resize_h_w_aspect,
 rotate, random_rotate_90, random_scale_limit, random_crop_h_w,
 center_crop_h_w, horizontal_flip, max_time_step.
+
+Keypoint data types ((N,2)/(N,3) coordinate arrays) are co-transformed
+with the same parameters instead of pixel-resampled
+(ref: utils/data.py keypoint_params on the albumentations Compose), and
+the post-augmentation geometry (resize_h/w, crop_h/w, is_flipped) stays
+readable for ``vis::`` ops (ref: datasets/base.py:489-503).
 """
 
 from __future__ import annotations
@@ -33,12 +39,18 @@ def _parse_hw(value):
 
 
 class Augmentor:
-    def __init__(self, aug_cfg, interpolators=None):
+    def __init__(self, aug_cfg, interpolators=None, keypoint_data_types=None):
         self.cfg = dict(aug_cfg or {})
         self.interpolators = interpolators or {}
+        self.keypoint_data_types = list(keypoint_data_types or [])
         self.max_time_step = int(self.cfg.get("max_time_step", 1))
         self.original_h = 0
         self.original_w = 0
+        self.resize_h = 0
+        self.resize_w = 0
+        self.crop_h = 0
+        self.crop_w = 0
+        self.is_flipped = False
 
     def _interp(self, data_type):
         return _INTERP.get(self.interpolators.get(data_type), cv2.INTER_LINEAR)
@@ -92,11 +104,53 @@ class Augmentor:
         if is_flipped:
             ops.append(("hflip", None))
 
+        # expose the post-augmentation geometry for vis:: ops
+        self.resize_h, self.resize_w = h, w
+        if crop:
+            self.crop_h, self.crop_w = crop[2], crop[3]
+            self.resize_h, self.resize_w = crop[2], crop[3]
+        else:
+            self.crop_h, self.crop_w = h, w
+        self.is_flipped = is_flipped
+
         out = {}
         for data_type, frames in inputs.items():
+            if data_type in self.keypoint_data_types:
+                out[data_type] = [self._apply_keypoints(f, ops) for f in frames]
+                continue
             interp = self._interp(data_type)
             out[data_type] = [self._apply(f, ops, interp) for f in frames]
         return out, is_flipped
+
+    def _apply_keypoints(self, pts, ops):
+        """Co-transform (N, 2[+extra]) xy coordinates with the image ops."""
+        pts = np.asarray(pts, np.float32).copy()
+        if pts.ndim != 2 or pts.shape[-1] < 2:
+            return pts
+        h, w = self.original_h, self.original_w
+        for op, arg in ops:
+            if op == "resize":
+                nh, nw = arg
+                pts[:, 0] *= nw / max(w, 1)
+                pts[:, 1] *= nh / max(h, 1)
+                h, w = nh, nw
+            elif op == "rotate":
+                m = cv2.getRotationMatrix2D((w / 2, h / 2), arg, 1.0)
+                xy1 = np.concatenate([pts[:, :2], np.ones((len(pts), 1))], 1)
+                pts[:, :2] = xy1 @ m.T
+            elif op == "rot90":
+                for _ in range(arg):
+                    x, y = pts[:, 0].copy(), pts[:, 1].copy()
+                    pts[:, 0], pts[:, 1] = y, w - 1 - x
+                    h, w = w, h
+            elif op == "crop":
+                top, left, ch, cw = arg
+                pts[:, 0] -= left
+                pts[:, 1] -= top
+                h, w = ch, cw
+            elif op == "hflip":
+                pts[:, 0] = w - 1 - pts[:, 0]
+        return pts
 
     @staticmethod
     def _apply(img, ops, interp):

@@ -29,6 +29,12 @@ def _decode_image(buf, ext):
         from io import BytesIO
 
         return np.load(BytesIO(buf))
+    if ext in ("json", "txt"):
+        # raw text payloads (keypoint JSON etc.) decode via data-pipeline
+        # ops like decode_json (ref: datasets/base.py:446-452)
+        return buf.decode("utf-8")
+    if ext in ("pkl", "pickle"):
+        return buf
     arr = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), cv2.IMREAD_UNCHANGED)
     if arr is None:
         raise ValueError("failed to decode image buffer")

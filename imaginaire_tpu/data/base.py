@@ -73,6 +73,10 @@ class BaseDataset:
             self.post_aug_ops[name] = _parse_ops(cfg_get(info, "post_aug_ops", "None"))
         self.input_labels = list(cfg_get(self.cfgdata, "input_labels", None) or [])
         self.input_image = list(cfg_get(self.cfgdata, "input_image", None) or [])
+        self.keypoint_data_types = list(
+            cfg_get(self.cfgdata, "keypoint_data_types", None) or [])
+        self.full_data_ops = _parse_ops(
+            cfg_get(self.cfgdata, "full_data_ops", "None"))
 
         # Backends + sequence lists per root.
         self.backends = {t: [] for t in self.data_types}
@@ -96,7 +100,8 @@ class BaseDataset:
                     self.backends[t].append(PackedBackend(path, self.extensions[t]))
 
         aug_cfg = cfg_get(data_info, "augmentations", None) or {}
-        self.augmentor = Augmentor(aug_cfg, self.interpolators)
+        self.augmentor = Augmentor(aug_cfg, self.interpolators,
+                                   keypoint_data_types=self.keypoint_data_types)
 
     # ------------------------------------------------------------------ api
 
@@ -132,24 +137,33 @@ class BaseDataset:
     def process_item(self, data):
         """pre-ops -> joint augmentation -> post-ops -> normalize/one-hot ->
         concat labels. Returns dict of (T,H,W,C) or (H,W,C) float arrays."""
+        # Key the 0-255 -> 0-1 rescale off the SOURCE dtype, not a value
+        # heuristic (float-valued data like .npy flow fields can exceed
+        # 1.5 and must not be divided by 255).
+        was_uint8 = {t: (len(data[t]) > 0 and
+                         getattr(data[t][0], "dtype", None) == np.uint8)
+                     for t in self.data_types}
         data = self._apply_ops(data, self.pre_aug_ops)
         data, is_flipped = self.augmentor.perform_augmentation(
             data, paired=True)
         data = self._apply_ops(data, self.post_aug_ops)
+        data = self._apply_full_data_ops(data)
 
         out = {}
         for t in self.data_types:
             frames = []
             for arr in data[t]:
+                arr = np.asarray(arr)
+                vis_output = arr.ndim == 3 and t in self.keypoint_data_types
                 arr = arr.astype(np.float32)
-                if arr.dtype != np.float32:
-                    arr = arr.astype(np.float32)
-                if self.is_mask[t] or (self.num_channels[t] and
-                                       arr.shape[-1] == 1 and self.num_channels[t] > 1):
+                if self.is_mask[t] or (self.num_channels[t] and arr.ndim == 3
+                                       and arr.shape[-1] == 1
+                                       and self.num_channels[t] > 1
+                                       and not vis_output):
                     arr = self._encode_onehot(
                         arr, self.num_channels[t], self.use_dont_care[t])
                 else:
-                    if arr.max() > 1.5:  # uint8-range input
+                    if was_uint8[t]:
                         arr = arr / 255.0
                     if self.normalize[t]:
                         arr = arr * 2.0 - 1.0
@@ -184,20 +198,60 @@ class BaseDataset:
         return out
 
     def _apply_ops(self, data, op_dict):
-        """'module::function' plugin ops (ref: base.py:386-460)."""
+        """Plugin ops with the reference's spec grammar
+        (ref: base.py:386-515): builtins (decode_json/decode_pkl/
+        to_numpy), 'module::function' per-type ops, and the prefixed
+        'vis::module::function' (receives the augmentation geometry and
+        turns keypoints into rendered label maps) / 
+        'convert::module::function' forms."""
         for t, ops in op_dict.items():
-            for op in ops:
-                data[t] = op(data[t])
+            if t not in data:
+                continue
+            for spec in ops:
+                fn, op_type = self._resolve_op(spec)
+                data[t] = fn(data[t])
         return data
+
+    def _apply_full_data_ops(self, data):
+        """Ops over the whole data dict (ref: base.py:399-406)."""
+        for spec in self.full_data_ops:
+            module, fn_name = spec.split("::")
+            fn = getattr(importlib.import_module(module), fn_name)
+            data = fn(self.cfgdata, self.is_inference, data)
+        return data
+
+    def _resolve_op(self, spec):
+        """(ref: base.py:434-515)."""
+        import json
+        import pickle
+        from functools import partial
+
+        if spec == "decode_json":
+            return (lambda frames: [json.loads(f) if isinstance(f, (str, bytes))
+                                    else f for f in frames]), None
+        if spec == "decode_pkl":
+            return (lambda frames: [pickle.loads(f) for f in frames]), None
+        if spec == "to_numpy":
+            return (lambda frames: [np.asarray(f) for f in frames]), None
+        parts = str(spec).split("::")
+        if len(parts) == 2:
+            module, fn_name = parts
+            return getattr(importlib.import_module(module), fn_name), None
+        if len(parts) == 3:
+            op_type, module, fn_name = parts
+            fn = getattr(importlib.import_module(module), fn_name)
+            if op_type == "vis":
+                aug = self.augmentor
+                return partial(fn, aug.resize_h, aug.resize_w, aug.crop_h,
+                               aug.crop_w, aug.original_h, aug.original_w,
+                               aug.is_flipped, self.cfgdata), "vis"
+            if op_type == "convert":
+                return fn, "convert"
+        raise ValueError(f"Unknown op spec {spec!r}")
 
 
 def _parse_ops(spec):
     if not spec or spec == "None":
         return []
-    ops = []
-    for item in str(spec).split(","):
-        item = item.strip()
-        if "::" in item:
-            module, fn = item.split("::")
-            ops.append(getattr(importlib.import_module(module), fn))
-    return ops
+    return [item.strip() for item in str(spec).split(",")
+            if item.strip() and item.strip() != "None"]

@@ -1,0 +1,69 @@
+"""Class-conditional image dataset (ref: imaginaire/datasets/images.py:10-197).
+
+Folder layout: <root>/images/<class_name>/<files>; the class index comes
+from the first path segment. Training samples a random image (optionally
+restricted to one class via ``set_sample_class_idx``); emits
+``images`` + integer ``labels``.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from imaginaire_tpu.data.base import BaseDataset
+from imaginaire_tpu.data.unpaired_images import (
+    load_unpaired_type,
+    type_sequences,
+)
+
+
+class Dataset(BaseDataset):
+    def __init__(self, cfg, is_inference=False, is_test=False):
+        super().__init__(cfg, is_inference, is_test)
+        t = self.data_types[0]
+        self.image_type = t
+        self.items = []
+        class_names = set()
+        for root_idx, root in enumerate(self.roots):
+            for seq, stems in type_sequences(self, root_idx, root, t).items():
+                cls = seq.split("/")[0]
+                class_names.add(cls)
+                for stem in stems:
+                    self.items.append((root_idx, seq, stem, cls))
+        self.class_name_to_idx = {c: i for i, c
+                                  in enumerate(sorted(class_names))}
+        self.num_classes = len(self.class_name_to_idx)
+        self.items_by_class = {}
+        for item in self.items:
+            idx = self.class_name_to_idx[item[3]]
+            self.items_by_class.setdefault(idx, []).append(item)
+        self.sample_class_idx = None
+        self.epoch_length = len(self.items)
+
+    def set_sample_class_idx(self, class_idx=None):
+        """(ref: images.py:23-31)."""
+        self.sample_class_idx = class_idx
+        self.epoch_length = (len(self.items) if class_idx is None
+                             else len(self.items_by_class[class_idx]))
+
+    def __len__(self):
+        return self.epoch_length
+
+    def __getitem__(self, index):
+        if self.sample_class_idx is not None:
+            pool = self.items_by_class[self.sample_class_idx]
+        else:
+            pool = self.items
+        item = (pool[index % len(pool)] if self.is_inference
+                else random.choice(pool))
+        root_idx, seq, stem, cls = item
+        image, flipped = load_unpaired_type(self, self.image_type, root_idx,
+                                            seq, stem)
+        return {
+            self.image_type: image,
+            "labels": np.asarray(self.class_name_to_idx[cls], np.int32),
+            "is_flipped": np.asarray(flipped),
+            "key": f"{seq}/{stem}",
+        }

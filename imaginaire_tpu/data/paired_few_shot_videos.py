@@ -1,0 +1,81 @@
+"""Paired few-shot video dataset — fs-vid2vid
+(ref: imaginaire/datasets/paired_few_shot_videos.py:33-300).
+
+Like paired_videos, but each sample also carries K reference frames
+(ref_images / ref_labels) drawn from the same sequence, disjoint from
+the training window (ref: paired_few_shot_videos.py:120-200).
+Inference mode pins the content sequence and the k-shot frame
+(``set_inference_sequence_idx``).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from imaginaire_tpu.config import cfg_get
+from imaginaire_tpu.data.paired_videos import Dataset as VideoDataset
+
+
+class Dataset(VideoDataset):
+    def __init__(self, cfg, is_inference=False, is_test=False):
+        super().__init__(cfg, is_inference, is_test)
+        self.few_shot_K = cfg_get(self.cfgdata, "initial_few_shot_K", 1)
+        self.inference_sequence_idx = 0
+        self.inference_k_shot_sequence_index = 0
+        self.inference_k_shot_frame_index = 0
+        self._rebuild()
+
+    def set_inference_sequence_idx(self, index, k_shot_index=None,
+                                   k_shot_frame_index=0):
+        """(ref: paired_few_shot_videos.py:92-107)."""
+        self.inference_sequence_idx = index % len(self.sequences)
+        self.inference_k_shot_sequence_index = (
+            self.inference_sequence_idx if k_shot_index is None
+            else k_shot_index % len(self.sequences))
+        self.inference_k_shot_frame_index = k_shot_frame_index
+        self.epoch_length = len(
+            self.sequences[self.inference_sequence_idx][2])
+
+    def set_few_shot_K(self, k):
+        self.few_shot_K = int(k)
+        self._rebuild()
+
+    def _rebuild(self):
+        few_shot_K = getattr(self, "few_shot_K", 1)
+        self.valid = [s for s in self.sequences
+                      if len(s[2]) >= self.sequence_length + few_shot_K]
+        self.epoch_length = max(len(self.valid), 1)
+
+    def __getitem__(self, index):
+        if self.is_inference:
+            root_idx, seq, stems = self.sequences[self.inference_sequence_idx]
+            frames = [stems[index % len(stems)]]
+            ref_root, ref_seq, ref_stems = self.sequences[
+                self.inference_k_shot_sequence_index]
+            ref_frames = [ref_stems[self.inference_k_shot_frame_index
+                                    % len(ref_stems)]]
+        else:
+            root_idx, seq, stems = self.valid[index % len(self.valid)]
+            max_start = len(stems) - self.sequence_length - self.few_shot_K
+            start = random.randint(0, max(max_start, 0))
+            frames = stems[start:start + self.sequence_length]
+            # K reference frames disjoint from the window
+            pool = list(range(0, start)) + list(
+                range(start + self.sequence_length, len(stems)))
+            ref_frames = [stems[i] for i in
+                          sorted(random.sample(pool, self.few_shot_K))]
+            ref_root, ref_seq = root_idx, seq
+
+        raw = self.load_item(root_idx, seq, frames)
+        out = self.process_item(raw)
+        out = self.concat_labels(out)
+        ref_raw = self.load_item(ref_root, ref_seq, ref_frames)
+        ref = self.process_item(ref_raw)
+        ref = self.concat_labels(ref)
+        out["ref_images"] = ref["images"]  # (K, H, W, C)
+        if "label" in ref:
+            out["ref_labels"] = ref["label"]
+        out["key"] = f"{seq}/{frames[-1]}"
+        return out

@@ -52,6 +52,7 @@ def load_unpaired_type(dataset, data_type, root_idx, seq, stem):
     (HWC float32 array, is_flipped bool for this domain's own draw).
     """
     arr = dataset.backends[data_type][root_idx].getitem(f"{seq}/{stem}")
+    was_uint8 = getattr(arr, "dtype", None) == np.uint8
     data = {data_type: [arr]}
     data = dataset._apply_ops(data, {data_type: dataset.pre_aug_ops[data_type]})
     data, is_flipped = dataset.augmentor.perform_augmentation(
@@ -59,7 +60,7 @@ def load_unpaired_type(dataset, data_type, root_idx, seq, stem):
     data = dataset._apply_ops(data,
                               {data_type: dataset.post_aug_ops[data_type]})
     arr = data[data_type][0].astype(np.float32)
-    if arr.max() > 1.5:
+    if was_uint8:  # rescale keyed off the SOURCE dtype, like base.py
         arr = arr / 255.0
     if dataset.normalize[data_type]:
         arr = arr * 2.0 - 1.0

@@ -39,7 +39,7 @@ from imaginaire_tpu.layers.residual import (
     UpRes2dBlock,
 )
 from imaginaire_tpu.layers.non_local import NonLocal2dBlock
-from imaginaire_tpu.layers.misc import ApplyNoise
+from imaginaire_tpu.layers.misc import ApplyNoise, PartialSequential
 
 __all__ = [
     "Conv1dBlock",
@@ -61,4 +61,5 @@ __all__ = [
     "MultiOutRes2dBlock",
     "NonLocal2dBlock",
     "ApplyNoise",
+    "PartialSequential",
 ]

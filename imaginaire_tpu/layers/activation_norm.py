@@ -251,35 +251,37 @@ class HyperSpatiallyAdaptiveNorm(nn.Module):
         c = x.shape[-1]
         hw = x.shape[1:3]
         y = _base_norm(self.base_norm, affine=False)(x, training=training)
-        gamma_sum = None
-        beta_sum = None
+        out = y
         for i, cond in enumerate(cond_inputs):
             if cond is None:
                 continue
+            mask = None
+            if isinstance(cond, (tuple, list)):
+                cond, mask = cond
+                mask = _resize(mask, hw, "bilinear")
             cond = _resize_nearest(cond, hw)
-            if i == 0 and norm_weights is not None and norm_weights[0] is not None:
+            if i == 0 and norm_weights is not None \
+                    and norm_weights[0] is not None:
+                # predicted per-sample conv emits the 2c affine params
+                # directly (ref: activation_norm.py:279-283, 317-321)
                 w, b = norm_weights
-                hidden = nn.relu(hyper_ops.per_sample_conv2d(cond, w, b, padding="SAME"))
+                affine = hyper_ops.per_sample_conv2d(cond, w, b,
+                                                     padding="SAME")
             else:
-                hidden = nn.relu(
-                    nn.Conv(
-                        max(self.num_filters, c),
+                h = cond
+                if self.num_filters > 0:
+                    h = nn.relu(nn.Conv(
+                        self.num_filters,
                         (self.kernel_size, self.kernel_size),
-                        padding="SAME",
-                        name=f"mlp_{i}",
-                    )(cond)
-                )
-            gamma = nn.Conv(
-                c, (self.kernel_size, self.kernel_size), padding="SAME", name=f"gamma_{i}"
-            )(hidden)
-            beta = nn.Conv(
-                c, (self.kernel_size, self.kernel_size), padding="SAME", name=f"beta_{i}"
-            )(hidden)
-            gamma_sum = gamma if gamma_sum is None else gamma_sum + gamma
-            beta_sum = beta if beta_sum is None else beta_sum + beta
-        if gamma_sum is None:
-            return y
-        return y * (1.0 + gamma_sum) + beta_sum
+                        padding="SAME", name=f"mlp_{i}")(h))
+                affine = nn.Conv(2 * c, (self.kernel_size, self.kernel_size),
+                                 padding="SAME", name=f"gb_{i}")(h)
+            gamma, beta = jnp.split(affine, 2, axis=-1)
+            if mask is not None:
+                gamma = gamma * (1 - mask)
+                beta = beta * (1 - mask)
+            out = out * (1.0 + gamma) + beta
+        return out
 
 
 def _base_norm(kind, affine):

@@ -1,9 +1,25 @@
-"""Noise injection (ref: imaginaire/layers/misc.py:9-30)."""
+"""Noise injection + partial-conv sequencing
+(ref: imaginaire/layers/misc.py:9-47)."""
 
 from __future__ import annotations
 
 import jax
 from flax import linen as nn
+
+
+class PartialSequential(nn.Module):
+    """Thread (activation, mask) through a chain of partial conv blocks
+    (ref: layers/misc.py:32-47): the input's last channel is the initial
+    validity mask; returns the final activation."""
+
+    layers: tuple
+
+    def __call__(self, x, training=False):
+        act = x[..., :-1]
+        mask = x[..., -1:]
+        for layer in self.layers:
+            act, mask = layer(act, mask_in=mask, training=training)
+        return act
 
 
 class ApplyNoise(nn.Module):

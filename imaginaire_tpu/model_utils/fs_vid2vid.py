@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from imaginaire_tpu.ops.resample2d import resample2d
 
@@ -35,6 +36,15 @@ def pick_image(images, idx):
         return images[:, idx]
     idx = idx.reshape(-1).astype(jnp.int32)
     return jax.vmap(lambda imgs, i: imgs[i])(images, idx)
+
+
+def fold_time(x):
+    """(B, T, H, W, C) -> (B, H, W, T*C). NHWC needs the explicit
+    transpose — a bare reshape row-major-mixes T into H/W (the torch
+    reference's .view(b,-1,h,w) is only valid in NCHW where T sits next
+    to C)."""
+    b, t, h, w, c = x.shape
+    return jnp.transpose(x, (0, 2, 3, 1, 4)).reshape(b, h, w, t * c)
 
 
 def concat_frames(prev, now, n_frames):
@@ -116,6 +126,93 @@ def get_all_skipped_frames(past_frames, new_frames, t_scales, tD):
         new_past.append(past)
         skipped.append(sk)
     return new_past, skipped
+
+
+def get_face_bbox_for_data(keypoints, orig_img_size, scale, is_inference,
+                           rng=None):
+    """Square face crop box around the landmarks with train-time jitter
+    (ref: fs_vid2vid.py:149-220). Returns ([y0, y1, x0, x1], scale)."""
+    import numpy as np
+
+    keypoints = np.asarray(keypoints)
+    min_y, max_y = int(keypoints[:, 1].min()), int(keypoints[:, 1].max())
+    min_x, max_x = int(keypoints[:, 0].min()), int(keypoints[:, 0].max())
+    x_cen, y_cen = (min_x + max_x) // 2, (min_y + max_y) // 2
+    H, W = orig_img_size
+    w = h = max(max_x - min_x, 1)
+    rng = rng or np.random
+    if not is_inference:
+        offset = [rng.uniform(-0.2, 0.2), rng.uniform(-0.2, 0.2)]
+        if scale is None:
+            scale = [rng.uniform(0.8, 1.2), rng.uniform(0.8, 1.2)]
+        w = int(w * scale[0])
+        h = int(h * scale[1])
+        x_cen += int(offset[0] * w)
+        y_cen += int(offset[1] * h)
+    # pad the tight box to ~2.5x the landmark extent, clamped to the frame
+    w = h = int(max(w, h) * 1.25)
+    x_cen = min(max(x_cen, w), W - w)
+    y_cen = min(max(y_cen, h), H - h)
+    y0, y1 = max(y_cen - h, 0), min(y_cen + h, H)
+    x0, x1 = max(x_cen - w, 0), min(x_cen + w, W)
+    return [y0, y1, x0, x1], scale
+
+
+def crop_and_resize(arrays, crop_coords, size):
+    """Crop (T, H, W, C) stacks and resize to ``size``
+    (ref: fs_vid2vid.py:223-258)."""
+    import cv2
+    import numpy as np
+
+    y0, y1, x0, x1 = crop_coords
+    out = []
+    for arr in arrays:
+        if arr is None:
+            out.append(None)
+            continue
+        arr = np.asarray(arr)
+        frames = []
+        for f in arr:
+            c = f[y0:y1, x0:x1]
+            c = cv2.resize(c, (size[1], size[0]),
+                           interpolation=cv2.INTER_LINEAR)
+            if c.ndim == 2:
+                c = c[:, :, None]
+            frames.append(c)
+        out.append(np.stack(frames))
+    return out
+
+
+def crop_face_from_data(cfg, is_inference, data):
+    """Crop the face region in a few-shot face batch and resize to
+    cfg.output_h_w (ref: fs_vid2vid.py:100-146). Operates on the data
+    pipeline's numpy dict (full_data op)."""
+    from imaginaire_tpu.config import cfg_get
+
+    landmarks = data.get("landmarks-dlib68_xy")
+    if landmarks is None:
+        return data
+    h, w = [int(v) for v in str(cfg_get(cfg, "output_h_w", "256,256")
+                                ).split(",")]
+    image = data["images"]
+    img_size = np.asarray(image).shape[1:3]
+    crop_coords, scale = get_face_bbox_for_data(
+        np.asarray(landmarks)[0], img_size, None, is_inference)
+    label = data.get("label")
+    label, image = crop_and_resize([label, image], crop_coords, (h, w))
+    data["images"] = image
+    if label is not None:
+        data["label"] = label
+    if "ref_images" in data:
+        ref_landmarks = data.get("ref_landmarks-dlib68_xy", landmarks)
+        ref_coords, _ = get_face_bbox_for_data(
+            np.asarray(ref_landmarks)[0], img_size, scale, is_inference)
+        ref_label, ref_images = crop_and_resize(
+            [data.get("ref_labels"), data["ref_images"]], ref_coords, (h, w))
+        data["ref_images"] = ref_images
+        if ref_label is not None:
+            data["ref_labels"] = ref_label
+    return data
 
 
 def extract_valid_pose_labels(pose_map, pose_type, remove_face_labels,

@@ -1,0 +1,85 @@
+"""World-consistent vid2vid utilities
+(ref: imaginaire/model_utils/wc_vid2vid/render.py:11-199).
+
+The SplatRenderer keeps a persistent color per 3D point of a
+structure-from-motion point cloud; each generated frame colors the
+points it sees first, and later frames render those colors back as a
+guidance image + validity mask. Pure host-side numpy by design: the
+point cloud is ragged and data-dependent, so it lives outside jit —
+the generator consumes only the dense (H, W, 4) guidance tensor.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SplatRenderer:
+    """(ref: render.py:11-148)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.colors = np.zeros((0, 3), np.uint8)
+        self.seen_mask = np.zeros((0, 1), np.uint8)
+        self.seen_time = np.zeros((0, 1), np.uint16)
+        self.call_idx = 0
+
+    def num_points(self):
+        return int(self.seen_mask.sum())
+
+    def _ensure_capacity(self, max_point_idx):
+        """Grow the per-point arrays (ref: render.py:38-61)."""
+        n = self.colors.shape[0]
+        if max_point_idx <= n:
+            return
+        grow = max_point_idx - n
+        self.colors = np.concatenate(
+            [self.colors, np.zeros((grow, 3), np.uint8)])
+        self.seen_mask = np.concatenate(
+            [self.seen_mask, np.zeros((grow, 1), np.uint8)])
+        self.seen_time = np.concatenate(
+            [self.seen_time, np.zeros((grow, 1), np.uint16)])
+
+    def update_point_cloud(self, image, point_info):
+        """Color the not-yet-seen points visible in this frame
+        (ref: render.py:63-100). image: (H, W, 3) uint8;
+        point_info: (N, 3) rows of (i, j, point_idx)."""
+        if point_info is None or len(point_info) == 0:
+            return
+        self.call_idx += 1
+        point_info = np.asarray(point_info)
+        i, j, idx = point_info[:, 0], point_info[:, 1], point_info[:, 2]
+        self._ensure_capacity(int(idx.max()) + 1)
+        unseen = self.seen_mask[idx, 0] == 0
+        self.colors[idx[unseen]] = image[i[unseen], j[unseen]]
+        self.seen_time[idx[unseen]] = self.call_idx
+        self.seen_mask[idx] = 1
+
+    def render_image(self, point_info, w, h, return_mask=False):
+        """Paint known point colors into an (h, w) canvas
+        (ref: render.py:102-148)."""
+        output = np.zeros((h, w, 3), np.uint8)
+        mask = np.zeros((h, w, 1), np.uint8)
+        if point_info is not None and len(point_info):
+            point_info = np.asarray(point_info)
+            i, j, idx = point_info[:, 0], point_info[:, 1], point_info[:, 2]
+            self._ensure_capacity(int(idx.max()) + 1)
+            output[i, j] = self.colors[idx]
+            mask[i, j] = 255 * self.seen_mask[idx]
+        if return_mask:
+            return output, mask
+        return output
+
+
+def guidance_tensor(renderer, point_info, w, h, flipped=False):
+    """Render guidance as a float (H, W, 4) array: RGB in [-1, 1] +
+    validity mask in [0, 1] (ref: generators/wc_vid2vid.py:101-135)."""
+    image, mask = renderer.render_image(point_info, w, h, return_mask=True)
+    if flipped:
+        image = np.fliplr(image).copy()
+        mask = np.fliplr(mask).copy()
+    image = image.astype(np.float32) / 255.0 * 2.0 - 1.0
+    mask = mask.astype(np.float32) / 255.0
+    return np.concatenate([image, mask], axis=-1)

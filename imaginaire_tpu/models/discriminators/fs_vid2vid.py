@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from imaginaire_tpu.config import as_attrdict, cfg_get
-from imaginaire_tpu.model_utils.fs_vid2vid import get_fg_mask, pick_image
+from imaginaire_tpu.model_utils.fs_vid2vid import fold_time, get_fg_mask, pick_image
 from imaginaire_tpu.models.discriminators.multires_patch import (
     MultiResPatchDiscriminator,
 )
@@ -29,12 +29,6 @@ from imaginaire_tpu.utils.data import (
     get_paired_input_image_channel_number,
     get_paired_input_label_channel_number,
 )
-
-
-def _fold_time(x):
-    """(B, T, H, W, C) -> (B, H, W, T*C)."""
-    b, t, h, w, c = x.shape
-    return jnp.transpose(x, (0, 2, 3, 1, 4)).reshape(b, h, w, t * c)
 
 
 def _make_patch_dis(dis_cfg, name):
@@ -117,6 +111,6 @@ class Discriminator(nn.Module):
             fake_stack = jnp.concatenate(
                 [past_fake, fake_image[:, None]], axis=1)
             output[f"temporal_{s}"] = self._discriminate_image(
-                self.temporal_ds[s], None, _fold_time(real_stack),
-                _fold_time(fake_stack), training)
+                self.temporal_ds[s], None, fold_time(real_stack),
+                fold_time(fake_stack), training)
         return output

@@ -1,0 +1,652 @@
+"""Few-shot vid2vid generator
+(ref: imaginaire/generators/fs_vid2vid.py:24-1069).
+
+A WeightGenerator encodes the reference image(s) (attention-combining K
+references) and predicts per-sample conv/SPADE weights for the hyper
+layers of the main branch; the label embedding can itself be hyper. Two
+flow networks warp the reference image and the previous frame, both
+fused into the first ``num_multi_spade_layers`` SPADE layers.
+
+TPU-first: per-sample predicted weights run through vmap'd convs
+(layers/hyper_ops), the K-reference attention is one batched matmul
+(MXU), and — as with vid2vid — every submodule exists from init, the
+curriculum only switches static trace flags. The reference's
+weight-caching across frames at eval (fs_vid2vid.py:594-607) is a
+host-side memoization we skip: recomputation is one fused program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from imaginaire_tpu.config import as_attrdict, cfg_get
+from imaginaire_tpu.layers import Conv2dBlock, HyperRes2dBlock, LinearBlock, Res2dBlock
+from imaginaire_tpu.model_utils.fs_vid2vid import (
+    extract_valid_pose_labels,
+    fold_time,
+    pick_image,
+    resample,
+)
+from imaginaire_tpu.models.generators.embedders import LabelEmbedder
+from imaginaire_tpu.utils.data import (
+    get_paired_input_image_channel_number,
+    get_paired_input_label_channel_number,
+)
+from imaginaire_tpu.utils.misc import upsample_2x
+
+
+class FSFlowGenerator(nn.Module):
+    """Flow/occlusion network conditioned on (label, src labels, src
+    images) (ref: fs_vid2vid.py:973-1069)."""
+
+    flow_cfg: Any
+    num_input_channels: int
+    num_img_channels: int
+    num_frames: int
+
+    @nn.compact
+    def __call__(self, label, src_label, src_image, training=False):
+        cfg = as_attrdict(self.flow_cfg)
+        num_downsamples = cfg_get(cfg, "num_downsamples", 3)
+        kernel_size = cfg_get(cfg, "kernel_size", 3)
+        num_blocks = cfg_get(cfg, "num_blocks", 6)
+        num_filters = cfg_get(cfg, "num_filters", 32)
+        max_num_filters = cfg_get(cfg, "max_num_filters", 1024)
+        multiplier = cfg_get(cfg, "flow_output_multiplier", 20)
+        sep_up_mask = cfg_get(cfg, "sep_up_mask", False)
+        an = cfg_get(cfg, "activation_norm_type", "sync_batch")
+        wn = cfg_get(cfg, "weight_norm_type", "spectral")
+
+        def nf(i):
+            return min(max_num_filters, num_filters * (2 ** i))
+
+        def conv(ch, name, stride=1):
+            return Conv2dBlock(ch, kernel_size=kernel_size, stride=stride,
+                               padding=kernel_size // 2, weight_norm_type=wn,
+                               activation_norm_type=an,
+                               nonlinearity="leakyrelu", name=name)
+
+        x = jnp.concatenate([label, src_label, src_image], axis=-1)
+        x = conv(num_filters, "down_in")(x, training=training)
+        for i in range(num_downsamples):
+            x = conv(nf(i + 1), f"down_{i}", stride=2)(x, training=training)
+        for i in range(num_blocks):
+            x = Res2dBlock(nf(num_downsamples), kernel_size,
+                           padding=kernel_size // 2, weight_norm_type=wn,
+                           activation_norm_type=an, order="NACNAC",
+                           name=f"res_{i}")(x, training=training)
+        res = x
+        for i in reversed(range(num_downsamples)):
+            x = upsample_2x(x)
+            x = conv(nf(i), f"up_{i}")(x, training=training)
+        flow = Conv2dBlock(2, kernel_size=kernel_size,
+                           padding=kernel_size // 2, name="conv_flow")(
+            x, training=training) * multiplier
+        if sep_up_mask:
+            m = res
+            for i in reversed(range(num_downsamples)):
+                m = upsample_2x(m)
+                m = conv(nf(i), f"up_mask_{i}")(m, training=training)
+        else:
+            m = x
+        mask = Conv2dBlock(1, kernel_size=kernel_size,
+                           padding=kernel_size // 2, nonlinearity="sigmoid",
+                           name="conv_mask")(m, training=training)
+        return flow, mask
+
+
+class AttentionModule(nn.Module):
+    """Combine K reference features with label-keyed attention
+    (ref: fs_vid2vid.py:888-970)."""
+
+    atn_cfg: Any
+    num_input_channels: int
+    few_shot_K: int
+    num_filters_each_layer: tuple
+
+    def setup(self):
+        cfg = as_attrdict(self.atn_cfg)
+        num_filters = cfg_get(cfg, "num_filters", 32)
+        self.num_downsample_atn = cfg_get(cfg, "num_downsamples", 2)
+        wn = cfg_get(cfg, "weight_norm_type", "spectral")
+        an = cfg_get(cfg, "activation_norm_type", "instance")
+
+        def conv(ch, name, stride=1):
+            return Conv2dBlock(ch, kernel_size=3, stride=stride, padding=1,
+                               weight_norm_type=wn, activation_norm_type=an,
+                               nonlinearity="leakyrelu", name=name)
+
+        self.query_first = conv(num_filters, "atn_query_first")
+        self.key_first = conv(num_filters, "atn_key_first")
+        self.key_downs = [conv(self.num_filters_each_layer[i + 1],
+                               f"atn_key_{i}", stride=2)
+                          for i in range(self.num_downsample_atn)]
+        self.query_downs = [conv(self.num_filters_each_layer[i + 1],
+                                 f"atn_query_{i}", stride=2)
+                            for i in range(self.num_downsample_atn)]
+
+    def _encode(self, img, first, downs, training):
+        x = first(img, training=training)
+        for layer in downs:
+            x = layer(x, training=training)
+        return x
+
+    def __call__(self, in_features, label, ref_label, attention=None,
+                 training=False):
+        """in_features: (B*K, H, W, C). Returns (combined (B,H,W,C),
+        attention (B, KHW, HW), atn_vis)."""
+        bk, h, w, c = in_features.shape
+        k = self.few_shot_K
+        b = bk // k
+        if attention is None:
+            atn_key = self._encode(ref_label, self.key_first, self.key_downs,
+                                   training)  # (B*K, h, w, c)
+            atn_query = self._encode(label, self.query_first,
+                                     self.query_downs, training)  # (B,h,w,c)
+            atn_key = atn_key.reshape(b, k * h * w, c)
+            atn_query = atn_query.reshape(b, h * w, c)
+            energy = jnp.einsum("bkc,bqc->bkq", atn_key, atn_query)
+            attention = jax.nn.softmax(energy, axis=1)  # (B, KHW, HW)
+        feats = in_features.reshape(b, k * h * w, c)
+        out = jnp.einsum("bkc,bkq->bqc", feats, attention).reshape(b, h, w, c)
+        atn_vis = attention.reshape(b, k, h * w, h * w).sum(axis=2).reshape(
+            b, k, h, w)
+        return out, attention, atn_vis[-1:, 0:1]
+
+
+class WeightGenerator(nn.Module):
+    """Encode the reference image(s); predict per-sample weights for the
+    hyper conv/SPADE/embedding layers (ref: fs_vid2vid.py:412-885)."""
+
+    gen_cfg: Any
+    data_cfg: Any
+
+    def setup(self):
+        gen_cfg = as_attrdict(self.gen_cfg)
+        data_cfg = as_attrdict(self.data_cfg)
+        num_filters = cfg_get(gen_cfg, "num_filters", 32)
+        self.num_downsamples = cfg_get(gen_cfg, "num_downsamples", 5)
+        max_num_filters = min(cfg_get(gen_cfg, "max_num_filters", 1024),
+                              num_filters * (2 ** self.num_downsamples))
+        self.nf = tuple(min(max_num_filters, num_filters * (2 ** i))
+                        for i in range(self.num_downsamples + 2))
+
+        hyper_cfg = as_attrdict(cfg_get(gen_cfg, "hyper", {}) or {})
+        self.use_hyper_spade = cfg_get(hyper_cfg, "is_hyper_spade", False)
+        self.use_hyper_embed = cfg_get(hyper_cfg, "is_hyper_embed", False)
+        self.use_hyper_conv = cfg_get(hyper_cfg, "is_hyper_conv", False)
+        self.num_hyper_layers = cfg_get(hyper_cfg, "num_hyper_layers", 4)
+        if self.num_hyper_layers == -1:
+            self.num_hyper_layers = self.num_downsamples
+        order = cfg_get(hyper_cfg, "hyper_block_order", "NAC")
+        self.conv_before_norm = order.find("C") < order.find("N")
+        method = cfg_get(hyper_cfg, "method_to_use_ref_labels", "concat")
+        self.concat_ref_label = "concat" in method
+        self.mul_ref_label = "mul" in method
+        self.sh_fix = self.sw_fix = 32
+        self.num_fc_layers = cfg_get(hyper_cfg, "num_fc_layers", 2)
+
+        self.embed_cfg = embed_cfg = cfg_get(gen_cfg, "embed", None)
+        self.embed_arch = cfg_get(embed_cfg, "arch", "encoderdecoder")
+        self.embed_kernel_size = cfg_get(embed_cfg, "kernel_size", 3)
+        self.spade_kernel_size = cfg_get(
+            cfg_get(gen_cfg, "activation_norm_params", {}) or {},
+            "kernel_size", 1)
+        self.conv_kernel_size = cfg_get(gen_cfg, "kernel_size", 3)
+
+        num_input_channels = get_paired_input_label_channel_number(data_cfg)
+        if cfg_get(as_attrdict(cfg_get(data_cfg, "for_pose_dataset", {})
+                               or {}), "pose_type", "both") == "open":
+            num_input_channels -= 3
+        self.num_input_channels = num_input_channels
+        num_img_channels = get_paired_input_image_channel_number(data_cfg)
+        num_ref_channels = num_img_channels + (
+            num_input_channels if self.concat_ref_label else 0)
+
+        kernel_size = cfg_get(hyper_cfg, "kernel_size", 3)
+        wn = cfg_get(hyper_cfg, "weight_norm_type", "spectral")
+        an = cfg_get(hyper_cfg, "activation_norm_type", "instance")
+
+        def conv(ch, name, stride=1):
+            return Conv2dBlock(ch, kernel_size=kernel_size, stride=stride,
+                               padding=kernel_size // 2, weight_norm_type=wn,
+                               activation_norm_type=an,
+                               nonlinearity="leakyrelu", name=name)
+
+        self.ref_img_first = conv(num_filters, "ref_img_first")
+        self.ref_img_downs = [conv(self.nf[i + 1], f"ref_img_down_{i}",
+                                   stride=2)
+                              for i in range(self.num_downsamples)]
+        self.ref_img_ups = [conv(self.nf[i], f"ref_img_up_{i}")
+                            for i in range(self.num_downsamples)]
+        if self.mul_ref_label:
+            self.ref_label_first = conv(num_filters, "ref_label_first")
+            self.ref_label_downs = [conv(self.nf[i + 1],
+                                         f"ref_label_down_{i}", stride=2)
+                                    for i in range(self.num_downsamples)]
+            self.ref_label_ups = [conv(self.nf[i], f"ref_label_up_{i}")
+                                  for i in range(self.num_downsamples)]
+
+        # FC stacks predicting the hyper weights (ref: fs_vid2vid.py:495-538)
+        def fc_stack(out_dim, ch_out, name):
+            layers = []
+            for k_ in range(self.num_fc_layers):
+                layers.append(LinearBlock(ch_out, weight_norm_type="spectral",
+                                          nonlinearity="leakyrelu",
+                                          name=f"{name}_fc{k_}"))
+            layers.append(LinearBlock(out_dim, weight_norm_type="spectral",
+                                      name=f"{name}_out"))
+            return layers
+
+        sks2 = self.spade_kernel_size ** 2
+        cks2 = self.conv_kernel_size ** 2
+        eks2 = self.embed_kernel_size ** 2
+        fc_stacks = {}
+        if self.use_hyper_spade or self.use_hyper_conv:
+            for i in range(self.num_hyper_layers):
+                ch_in, ch_out = self.nf[i], self.nf[i + 1]
+                spade_ch = self.nf[i]
+                if self.use_hyper_spade:
+                    mult0 = 1 if self.conv_before_norm else 2
+                    mult1 = 1 if ch_in != ch_out else 2
+                    fc_stacks[f"spade_0_{i}"] = fc_stack(
+                        (spade_ch * sks2 + 1) * mult0, ch_out, f"fc_spade_0_{i}")
+                    fc_stacks[f"spade_1_{i}"] = fc_stack(
+                        (spade_ch * sks2 + 1) * mult1, ch_out, f"fc_spade_1_{i}")
+                    fc_stacks[f"spade_s_{i}"] = fc_stack(
+                        (spade_ch * sks2 + 1) * mult0, ch_out, f"fc_spade_s_{i}")
+                    if self.use_hyper_embed:
+                        fc_stacks[f"spade_e_{i}"] = fc_stack(
+                            ch_in * eks2 + 1, ch_out, f"fc_spade_e_{i}")
+                if self.use_hyper_conv:
+                    fc_stacks[f"conv_0_{i}"] = fc_stack(
+                        ch_out * cks2 + 1, ch_out, f"fc_conv_0_{i}")
+                    fc_stacks[f"conv_1_{i}"] = fc_stack(
+                        ch_in * cks2 + 1, ch_out, f"fc_conv_1_{i}")
+                    fc_stacks[f"conv_s_{i}"] = fc_stack(
+                        ch_out + 1, ch_out, f"fc_conv_s_{i}")
+        self.fc_stacks = fc_stacks
+
+        self.label_embedding = LabelEmbedder(
+            embed_cfg, num_input_channels,
+            num_hyper_layers=(self.num_hyper_layers if self.use_hyper_embed
+                              else 0),
+            name="label_embedding")
+
+        self.few_shot_K = cfg_get(data_cfg, "initial_few_shot_K", 1)
+        atn_cfg = cfg_get(hyper_cfg, "attention", None)
+        self.num_downsample_atn = cfg_get(atn_cfg, "num_downsamples", 2) \
+            if atn_cfg is not None else 0
+        if atn_cfg is not None and self.few_shot_K > 1:
+            self.attention_module = AttentionModule(
+                atn_cfg, num_input_channels, self.few_shot_K, self.nf,
+                name="attention_module")
+
+    # ------------------------------------------------------------- weights
+
+    def _run_fc(self, stack, x, training):
+        for layer in stack:
+            x = layer(x, training=training)
+        return x
+
+    def _pool_rows(self, feat):
+        """(B, H, W, C) or (B, C, C') -> (B*C, D) rows for the FC stacks
+        (ref: reshape_embed_input + AdaptiveAvgPool 32x32,
+        fs_vid2vid.py:709-721)."""
+        if feat.ndim == 3:  # mul_ref_label channel-correlation features
+            b, c, d = feat.shape
+            return feat.reshape(b * c, d), b, c
+        b, h, w, c = feat.shape
+        feat = jax.image.resize(feat, (b, self.sh_fix, self.sw_fix, c),
+                                method="bilinear")
+        return (feat.transpose(0, 3, 1, 2).reshape(
+            b * c, self.sh_fix * self.sw_fix), b, c)
+
+    def _predict(self, name, feat, weight_shape, training):
+        """FC stack -> per-sample (kh, kw, cin, cout) kernels + bias."""
+        rows, b, c = self._pool_rows(feat)
+        out = self._run_fc(self.fc_stacks[name], rows, training)
+        flat = out.reshape(b, -1)
+        kh, kw, cin, cout = weight_shape
+        numel = kh * kw * cin * cout
+        w = flat[:, :numel].reshape(b, kh, kw, cin, cout)
+        bias = flat[:, numel:numel + cout]
+        return (w, bias)
+
+    def get_norm_weights(self, feat, i, training):
+        """(ref: fs_vid2vid.py:694-750)."""
+        ch_in, ch_out = self.nf[i], self.nf[i + 1]
+        spade_ch = self.nf[i]
+        sks = self.spade_kernel_size
+        eks = self.embed_kernel_size
+        embedding_weights = None
+        if self.use_hyper_embed:
+            # decoder-arch embeds map ch_out -> ch_in (up convs)
+            if "decoder" in self.embed_arch:
+                shape = (eks, eks, ch_out, ch_in)
+            else:
+                shape = (eks, eks, ch_in, ch_out)
+            embedding_weights = self._predict(f"spade_e_{i}", feat, shape,
+                                              training)
+        out_ch = ch_in if self.conv_before_norm else ch_out
+        w0 = self._predict(f"spade_0_{i}", feat,
+                           (sks, sks, spade_ch, out_ch * 2), training)
+        w1 = self._predict(f"spade_1_{i}", feat,
+                           (sks, sks, spade_ch, ch_in * 2), training)
+        ws = self._predict(f"spade_s_{i}", feat,
+                           (sks, sks, spade_ch, out_ch * 2), training)
+        return embedding_weights, [w0, w1, ws]
+
+    def get_conv_weights(self, feat, i, training):
+        """(ref: fs_vid2vid.py:752-780). Main-branch up_i maps
+        nf[i+1] -> nf[i]."""
+        ch_in, ch_out = self.nf[i], self.nf[i + 1]
+        cks = self.conv_kernel_size
+        w0 = self._predict(f"conv_0_{i}", feat, (cks, cks, ch_out, ch_in),
+                           training)
+        w1 = self._predict(f"conv_1_{i}", feat, (cks, cks, ch_in, ch_in),
+                           training)
+        ws = self._predict(f"conv_s_{i}", feat, (1, 1, ch_out, ch_in),
+                           training)
+        return [w0, w1, ws]
+
+    # ------------------------------------------------------------- forward
+
+    def encode_reference(self, ref_image, ref_label, label, k, training):
+        """(ref: fs_vid2vid.py:620-692)."""
+        if self.concat_ref_label:
+            x = self.ref_img_first(
+                jnp.concatenate([ref_image, ref_label], axis=-1),
+                training=training)
+            x_label = None
+        elif self.mul_ref_label:
+            x = self.ref_img_first(ref_image, training=training)
+            x_label = self.ref_label_first(ref_label, training=training)
+        else:
+            x = self.ref_img_first(ref_image, training=training)
+            x_label = None
+
+        atn = atn_vis = ref_idx = None
+        for i in range(self.num_downsamples):
+            x = self.ref_img_downs[i](x, training=training)
+            if self.mul_ref_label:
+                x_label = self.ref_label_downs[i](x_label, training=training)
+            if k > 1 and i == self.num_downsample_atn - 1:
+                x, atn, atn_vis = self.attention_module(
+                    x, label, ref_label, training=training)
+                if self.mul_ref_label:
+                    x_label, _, _ = self.attention_module(
+                        x_label, None, None, attention=atn,
+                        training=training)
+                b = label.shape[0]
+                atn_sum = atn.reshape(b, k, -1).sum(axis=2)
+                ref_idx = jnp.argmax(atn_sum, axis=1)
+
+        encoded_image_ref = [x]
+        encoded_label_ref = [x_label] if self.mul_ref_label else None
+        for i in reversed(range(self.num_downsamples)):
+            encoded_image_ref.append(
+                self.ref_img_ups[i](encoded_image_ref[-1],
+                                    training=training))
+            if self.mul_ref_label:
+                encoded_label_ref.append(
+                    self.ref_label_ups[i](encoded_label_ref[-1],
+                                          training=training))
+        if self.mul_ref_label:
+            encoded_ref = []
+            for conv, conv_label in zip(encoded_image_ref, encoded_label_ref):
+                conv_label = jax.nn.softmax(conv_label, axis=-1)
+                # (B, C, C') channel correlation pooled over space
+                # (ref: fs_vid2vid.py:676-686)
+                encoded_ref.append(
+                    jnp.einsum("bhwc,bhwd->bcd", conv, conv_label))
+            encoded_ref = encoded_ref[::-1]
+        else:
+            encoded_ref = encoded_image_ref[::-1]
+        return x, encoded_ref, atn, atn_vis, ref_idx
+
+    def __call__(self, ref_image, ref_label, label, is_first_frame,
+                 training=False):
+        b, k = ref_image.shape[0], ref_image.shape[1]
+        ref_image_flat = ref_image.reshape((b * k,) + ref_image.shape[2:])
+        ref_label_flat = (ref_label.reshape((b * k,) + ref_label.shape[2:])
+                          if ref_label is not None else None)
+
+        x, encoded_ref, atn, atn_vis, ref_idx = self.encode_reference(
+            ref_image_flat, ref_label_flat, label, k, training)
+
+        embedding_weights, norm_weights, conv_weights = [], [], []
+        for i in range(self.num_hyper_layers):
+            if self.use_hyper_spade:
+                feat = encoded_ref[min(len(encoded_ref) - 1, i + 1)]
+                ew, nw = self.get_norm_weights(feat, i, training)
+                embedding_weights.append(ew)
+                norm_weights.append(nw)
+            if self.use_hyper_conv:
+                feat = encoded_ref[min(len(encoded_ref) - 1, i)]
+                conv_weights.append(self.get_conv_weights(feat, i, training))
+
+        encoded_label = self.label_embedding(
+            label,
+            weights=(embedding_weights if self.use_hyper_embed else None),
+            training=training)
+        return (x, encoded_label, conv_weights, norm_weights, atn, atn_vis,
+                ref_idx)
+
+
+class Generator(nn.Module):
+    """(ref: fs_vid2vid.py:24-199)."""
+
+    gen_cfg: Any
+    data_cfg: Any
+
+    def setup(self):
+        gen_cfg = as_attrdict(self.gen_cfg)
+        data_cfg = as_attrdict(self.data_cfg)
+        self.num_frames_G = cfg_get(data_cfg, "num_frames_G", 2)
+        flow_cfg = as_attrdict(cfg_get(gen_cfg, "flow", {}) or {})
+        self.flow_cfg = flow_cfg
+
+        pose_cfg = cfg_get(data_cfg, "for_pose_dataset", None)
+        self.is_pose_data = pose_cfg is not None
+        self.pose_type = cfg_get(pose_cfg, "pose_type", "both") \
+            if self.is_pose_data else "both"
+        self.remove_face_labels = cfg_get(pose_cfg, "remove_face_labels",
+                                          False) if self.is_pose_data else False
+
+        num_img_channels = get_paired_input_image_channel_number(data_cfg)
+        self.num_img_channels = num_img_channels
+        self.num_downsamples = cfg_get(gen_cfg, "num_downsamples", 5)
+        kernel_size = cfg_get(gen_cfg, "kernel_size", 3)
+        num_filters = cfg_get(gen_cfg, "num_filters", 32)
+        max_num_filters = min(cfg_get(gen_cfg, "max_num_filters", 1024),
+                              num_filters * (2 ** self.num_downsamples))
+        nf = [min(max_num_filters, num_filters * (2 ** i))
+              for i in range(self.num_downsamples + 2)]
+
+        hyper_cfg = as_attrdict(cfg_get(gen_cfg, "hyper", {}) or {})
+        self.use_hyper_spade = cfg_get(hyper_cfg, "is_hyper_spade", False)
+        self.use_hyper_conv = cfg_get(hyper_cfg, "is_hyper_conv", False)
+        self.num_hyper_layers = cfg_get(hyper_cfg, "num_hyper_layers", 4)
+        if self.num_hyper_layers == -1:
+            self.num_hyper_layers = self.num_downsamples
+
+        self.weight_generator = WeightGenerator(gen_cfg, data_cfg,
+                                                name="weight_generator")
+
+        msc = as_attrdict(cfg_get(flow_cfg, "multi_spade_combine", {}) or {})
+        self.num_multi_spade_layers = cfg_get(msc, "num_layers", 3)
+        self.generate_raw_output = cfg_get(flow_cfg, "generate_raw_output",
+                                           False)
+
+        wn = cfg_get(gen_cfg, "weight_norm_type", "spectral")
+        an = cfg_get(gen_cfg, "activation_norm_type",
+                     "hyper_spatially_adaptive")
+        anp = dict(as_attrdict(cfg_get(gen_cfg, "activation_norm_params",
+                                       {}) or {}))
+        order = cfg_get(hyper_cfg, "hyper_block_order", "NAC")
+
+        self.up_blocks = [HyperRes2dBlock(
+            nf[i], kernel_size=kernel_size, weight_norm_type=wn,
+            activation_norm_type=an, activation_norm_params=anp,
+            order=order * 2, name=f"up_{i}")
+            for i in range(self.num_downsamples + 1)]
+        self.conv_img = Conv2dBlock(num_img_channels, kernel_size,
+                                    padding=kernel_size // 2,
+                                    nonlinearity="leakyrelu", order="AC",
+                                    name="conv_img")
+
+        num_input_channels = self.weight_generator.num_input_channels
+        self.warp_ref = cfg_get(flow_cfg, "warp_ref", True)
+        if self.warp_ref:
+            self.flow_network_ref = FSFlowGenerator(
+                flow_cfg, num_input_channels, num_img_channels, 2,
+                name="flow_network_ref")
+            self.ref_image_embedding = LabelEmbedder(
+                cfg_get(msc, "embed", None), num_img_channels + 1,
+                name="ref_image_embedding")
+        # temporal path (ref init_temporal_network, fs_vid2vid.py:221-290)
+        self.sep_prev_flownet = cfg_get(flow_cfg, "sep_prev_flow", False) or \
+            (self.num_frames_G != 2) or not self.warp_ref
+        if self.sep_prev_flownet:
+            self.flow_network_temp = FSFlowGenerator(
+                flow_cfg, num_input_channels, num_img_channels,
+                self.num_frames_G, name="flow_network_temp")
+        else:
+            self.flow_network_temp = self.flow_network_ref
+        self.sep_prev_embedding = cfg_get(msc, "sep_warp_embed", False) or \
+            not self.warp_ref
+        if self.sep_prev_embedding:
+            self.prev_image_embedding = LabelEmbedder(
+                cfg_get(msc, "embed", None), num_img_channels + 1,
+                name="prev_image_embedding")
+        else:
+            self.prev_image_embedding = self.ref_image_embedding
+
+    # ------------------------------------------------------------- helpers
+
+    def flow_generation(self, label, ref_labels, ref_images, prev_labels,
+                        prev_images, ref_idx, training, init_all):
+        """(ref: fs_vid2vid.py:305-360)."""
+        ref_label, ref_image = pick_image([ref_labels, ref_images], ref_idx)
+        has_prev = prev_labels is not None and \
+            prev_labels.shape[1] == self.num_frames_G - 1
+        flow = [None, None]
+        occ_mask = [None, None]
+        img_warp = [None, None]
+        cond_inputs = [None, None]
+        if self.warp_ref:
+            flow_ref, occ_ref = self.flow_network_ref(
+                label, ref_label, ref_image, training=training)
+            warp_ref = resample(ref_image, flow_ref)
+            flow[0], occ_mask[0] = flow_ref, occ_ref
+            img_warp[0] = warp_ref[..., :3]
+            cond_inputs[0] = jnp.concatenate([img_warp[0], occ_mask[0]],
+                                             axis=-1)
+        if has_prev or init_all:
+            b = label.shape[0]
+            h, w = label.shape[1:3]
+            if prev_labels is not None and has_prev:
+                prev_l = fold_time(prev_labels)
+                prev_i = fold_time(prev_images)
+                last_prev = prev_images[:, -1]
+            else:  # init_all stub shapes
+                nG = self.num_frames_G
+                prev_l = jnp.tile(label, (1, 1, 1, nG - 1))
+                prev_i = jnp.zeros(
+                    (b, h, w, self.num_img_channels * (nG - 1)), label.dtype)
+                last_prev = prev_i[..., :self.num_img_channels]
+            flow_prev, occ_prev = self.flow_network_temp(
+                label, prev_l, prev_i, training=training)
+            warp_prev = resample(last_prev, flow_prev)
+            flow[1], occ_mask[1], img_warp[1] = flow_prev, occ_prev, warp_prev
+            cond_inputs[1] = jnp.concatenate([img_warp[1], occ_mask[1]],
+                                             axis=-1)
+        return flow, occ_mask, img_warp, cond_inputs
+
+    def SPADE_combine(self, encoded_label, cond_inputs, training):
+        """(ref: fs_vid2vid.py:362-383)."""
+        embedded = [None, None]
+        if cond_inputs[0] is not None:
+            embedded[0] = self.ref_image_embedding(cond_inputs[0],
+                                                   training=training)
+        if cond_inputs[1] is not None:
+            embedded[1] = self.prev_image_embedding(cond_inputs[1],
+                                                    training=training)
+        for i in range(self.num_multi_spade_layers):
+            encoded_label[i] = encoded_label[i] + [
+                w[i] if w is not None else None for w in embedded]
+        return encoded_label
+
+    def _one_up_layer(self, x, cond, conv_w, norm_w, i, training):
+        x = self.up_blocks[i](x, *cond, conv_weights=conv_w,
+                              norm_weights=norm_w, training=training)
+        if i != 0:
+            x = upsample_2x(x)
+        return x
+
+    # ------------------------------------------------------------- forward
+
+    def __call__(self, data, training=False, init_all=False):
+        label = data["label"]
+        ref_labels, ref_images = data["ref_labels"], data["ref_images"]
+        prev_labels = data.get("prev_labels")
+        prev_images = data.get("prev_images")
+        is_first_frame = prev_labels is None
+
+        if self.is_pose_data:
+            label = extract_valid_pose_labels(label, self.pose_type,
+                                              self.remove_face_labels)
+            prev_labels = extract_valid_pose_labels(
+                prev_labels, self.pose_type, self.remove_face_labels)
+            ref_labels = extract_valid_pose_labels(
+                ref_labels, self.pose_type, self.remove_face_labels,
+                do_remove=False)
+
+        x, encoded_label, conv_weights, norm_weights, atn, atn_vis, ref_idx \
+            = self.weight_generator(ref_images, ref_labels, label,
+                                    is_first_frame, training=training)
+
+        flow, occ_mask, img_warp, cond_inputs = self.flow_generation(
+            label, ref_labels, ref_images, prev_labels, prev_images, ref_idx,
+            training, init_all)
+
+        encoded_label = [[e] for e in encoded_label]
+        if self.generate_raw_output:
+            encoded_label_raw = [encoded_label[i] for i in
+                                 range(self.num_multi_spade_layers)]
+        encoded_label = self.SPADE_combine(encoded_label, cond_inputs,
+                                           training)
+
+        x_raw = None
+        for i in range(self.num_downsamples, -1, -1):
+            conv_w = conv_weights[i] if (self.use_hyper_conv and
+                                         i < self.num_hyper_layers) else \
+                (None, None, None)
+            norm_w = norm_weights[i] if (self.use_hyper_spade and
+                                         i < self.num_hyper_layers) else \
+                (None, None, None)
+            x = self._one_up_layer(x, encoded_label[i], conv_w, norm_w, i,
+                                   training)
+            if self.generate_raw_output and i < self.num_multi_spade_layers:
+                src = x_raw if x_raw is not None else x
+                x_raw = self._one_up_layer(src, encoded_label_raw[i], conv_w,
+                                           norm_w, i, training)
+            else:
+                x_raw = x
+
+        img_final = jnp.tanh(self.conv_img(x, training=training))
+        img_raw = (jnp.tanh(self.conv_img(x_raw, training=training))
+                   if self.generate_raw_output else None)
+
+        return {"fake_images": img_final, "fake_flow_maps": flow,
+                "fake_occlusion_masks": occ_mask, "fake_raw_images": img_raw,
+                "warped_images": img_warp,
+                "attention_visualization": atn_vis, "ref_idx": ref_idx}
+
+    def inference(self, data, **kwargs):
+        return self(data, training=False)["fake_images"]

@@ -27,7 +27,7 @@ from flax import linen as nn
 
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.layers import Conv2dBlock, LinearBlock, Res2dBlock
-from imaginaire_tpu.model_utils.fs_vid2vid import resample
+from imaginaire_tpu.model_utils.fs_vid2vid import fold_time, resample
 from imaginaire_tpu.models.generators.embedders import LabelEmbedder
 from imaginaire_tpu.utils.data import (
     get_paired_input_image_channel_number,
@@ -257,10 +257,9 @@ class Generator(nn.Module):
 
     def _flow_warp(self, label, label_prev, img_prev, training):
         """(ref: vid2vid.py:222-236)."""
-        b, h, w, _ = label.shape
-        lbl_concat = jnp.concatenate(
-            [label_prev.reshape(b, h, w, -1), label], axis=-1)
-        img_concat = img_prev.reshape(b, h, w, -1)
+        lbl_concat = jnp.concatenate([fold_time(label_prev), label],
+                                     axis=-1)
+        img_concat = fold_time(img_prev)
         flow, mask = self.flow_network_temp(lbl_concat, img_concat,
                                             training=training)
         img_warp = resample(img_prev[:, -1], flow)

@@ -1,0 +1,128 @@
+"""World-consistent vid2vid generator
+(ref: imaginaire/generators/wc_vid2vid.py:19-359).
+
+vid2vid plus a physically-grounded guidance signal: colors splatted
+from a persistent SfM point cloud render into a guidance image + mask
+that conditions the SPADE layers (all layers, or only the flow-combined
+ones when ``only_with_flow``). ``partial_conv`` routes the guidance
+through mask-aware SPADE convs.
+
+TPU-first split: the reference embeds the host-side SplatRenderer in
+the generator; here the renderer lives in the trainer
+(model_utils/wc_vid2vid.SplatRenderer) and the generator is a pure
+function of the dense ``data['guidance']`` (B, H, W, 4) tensor.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from imaginaire_tpu.config import as_attrdict, cfg_get
+from imaginaire_tpu.models.generators.vid2vid import (
+    Generator as Vid2VidGenerator,
+)
+
+
+class Generator(Vid2VidGenerator):
+    """(ref: wc_vid2vid.py:19-359)."""
+
+    gen_cfg: Any
+    data_cfg: Any
+
+    def setup(self):
+        super().setup()
+        guidance_cfg = as_attrdict(cfg_get(self.gen_cfg, "guidance", {})
+                                   or {})
+        self.guidance_only_with_flow = cfg_get(guidance_cfg,
+                                               "only_with_flow", False)
+        self.guidance_partial_conv = cfg_get(guidance_cfg, "partial_conv",
+                                             False)
+
+    def _guidance_cond(self, data):
+        g = data.get("guidance")
+        if g is None:
+            return None
+        if self.guidance_partial_conv:
+            return (g[..., :3], g[..., 3:])  # (image, validity mask)
+        return g
+
+    def __call__(self, data, training=False, init_all=False):
+        """vid2vid forward with guidance appended to the SPADE conds
+        (ref: wc_vid2vid.py:137-296)."""
+        label = data["label"]
+        label_prev = data.get("prev_labels")
+        img_prev = data.get("prev_images")
+        is_first_frame = img_prev is None
+        guidance = self._guidance_cond(data)
+
+        embedder = self.label_embedding if self.use_embed else None
+        cond_maps_now = self.get_cond_maps(label, embedder, training)
+
+        if init_all:
+            b, h, w, _ = label.shape
+            nG = self.num_frames_G
+            stub_imgs = jnp.zeros((b, nG - 1, h, w, self.num_img_channels),
+                                  label.dtype)
+            stub_lbls = jnp.tile(label[:, None], (1, nG - 1, 1, 1, 1))
+            x_img = self._first_frame_trunk(data, cond_maps_now, training)
+            x_prev = self._prev_frame_trunk(stub_lbls, stub_imgs,
+                                            cond_maps_now, training)
+            x_img = x_img + 0.0 * x_prev
+            flow = mask = img_warp = None
+            if self.has_flow:
+                flow, mask, img_warp = self._flow_warp(
+                    label, stub_lbls, stub_imgs, training)
+                if self.spade_combine:
+                    img_embed = jnp.concatenate([img_warp, mask], axis=-1)
+                    cond_maps_img = self.get_cond_maps(
+                        img_embed, self.img_prev_embedding, training)
+            warp_prev = self.has_flow
+            if guidance is None:
+                # materialize the guidance SPADE params too
+                guidance = self._guidance_cond(
+                    {"guidance": jnp.zeros(label.shape[:3] + (4,),
+                                           label.dtype)})
+        elif is_first_frame:
+            x_img = self._first_frame_trunk(data, cond_maps_now, training)
+            warp_prev = False
+            flow = mask = img_warp = None
+        else:
+            x_img = self._prev_frame_trunk(label_prev, img_prev,
+                                           cond_maps_now, training)
+            warp_prev = (self.has_flow and
+                         label_prev.shape[1] == self.num_frames_G - 1)
+            flow = mask = img_warp = None
+            if warp_prev:
+                flow, mask, img_warp = self._flow_warp(
+                    label, label_prev, img_prev, training)
+                if self.spade_combine:
+                    img_embed = jnp.concatenate([img_warp, mask], axis=-1)
+                    cond_maps_img = self.get_cond_maps(
+                        img_embed, self.img_prev_embedding, training)
+
+        for i in range(self.num_downsamples_img, -1, -1):
+            j = min(i, self.num_downsamples_embed)
+            cond_maps = list(cond_maps_now[j])
+            # guidance participates only during temporal (warped) frames so
+            # the SPADE cond positions stay fixed per layer
+            # (ref: wc_vid2vid.py:263-276, 297-322)
+            if warp_prev:
+                if self.spade_combine and i < self.num_multi_spade_layers:
+                    cond_maps = cond_maps + list(cond_maps_img[j])
+                    if guidance is not None:
+                        cond_maps.append(guidance)
+                elif not self.guidance_only_with_flow and \
+                        guidance is not None:
+                    cond_maps.append(guidance)
+            x_img = self._one_up_layer(x_img, cond_maps, i, training)
+
+        img_final = jnp.tanh(self.conv_img(x_img, training=training))
+        if warp_prev and not self.spade_combine:
+            img_final = img_final * mask + img_warp * (1 - mask)
+
+        return {"fake_images": img_final, "fake_flow_maps": flow,
+                "fake_occlusion_masks": mask, "fake_raw_images": None,
+                "warped_images": img_warp,
+                "guidance_images_and_masks": data.get("guidance")}

@@ -301,9 +301,33 @@ class BaseTrainer:
         data = self._start_of_iteration(data, current_iteration)
         self.current_iteration = current_iteration
         self.start_iteration_time = time.time()
+        self._maybe_profile(current_iteration)
         from imaginaire_tpu.utils.misc import to_device
 
         return to_device(data)
+
+    def _maybe_profile(self, current_iteration):
+        """XLA profiler trace window (the jax-native replacement for the
+        reference's speed_benchmark nvprof runs, SURVEY §5.1): configure
+        cfg.trainer.profile = {start_iteration: N, num_iterations: K} to
+        capture steps [N, N+K) into <logdir>/profile for perfetto/xprof."""
+        pcfg = cfg_get(cfg_get(self.cfg, "trainer", {}) or {}, "profile",
+                       None)
+        if pcfg is None:
+            return
+        start = cfg_get(pcfg, "start_iteration", 10)
+        num = cfg_get(pcfg, "num_iterations", 5)
+        if current_iteration == start and not getattr(self, "_profiling",
+                                                      False):
+            path = os.path.join(cfg_get(self.cfg, "logdir", "."), "profile")
+            jax.profiler.start_trace(path)
+            self._profiling = True
+            print(f"jax.profiler trace started -> {path}")
+        elif getattr(self, "_profiling", False) and \
+                current_iteration >= start + num:
+            jax.profiler.stop_trace()
+            self._profiling = False
+            print("jax.profiler trace stopped")
 
     def end_of_iteration(self, data, current_epoch, current_iteration):
         """(ref: base.py:294-373)."""

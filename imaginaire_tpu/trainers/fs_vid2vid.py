@@ -1,0 +1,111 @@
+"""Few-shot vid2vid trainer (ref: imaginaire/trainers/fs_vid2vid.py:24-280).
+
+Inherits the vid2vid interleaved rollout; the generator additionally
+consumes K reference frames, and the flow outputs are [ref, prev]
+pairs — the flow loss sums over whichever entries are live.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from imaginaire_tpu.losses.flow import masked_l1_loss
+from imaginaire_tpu.model_utils.fs_vid2vid import concat_frames
+from imaginaire_tpu.trainers.base import MUTABLE
+from imaginaire_tpu.trainers.vid2vid import Trainer as Vid2VidTrainer
+from imaginaire_tpu.utils.misc import numeric_only, to_device
+
+
+class Trainer(Vid2VidTrainer):
+    def _frame0(self, data):
+        out = super()._frame0(data)
+        out["ref_images"] = data["ref_images"]
+        if "ref_labels" in data:
+            out["ref_labels"] = data["ref_labels"]
+        return out
+
+    def _get_data_t(self, data, t, prev_labels, prev_images):
+        data_t = super()._get_data_t(data, t, prev_labels, prev_images)
+        data_t["ref_images"] = data["ref_images"]
+        if "ref_labels" in data:
+            data_t["ref_labels"] = data["ref_labels"]
+        return data_t
+
+    def gen_forward(self, vars_G, vars_D, loss_params, data, rng,
+                    training=True):
+        """vid2vid losses with the two-entry (ref, prev) flow outputs
+        (ref: trainers/fs_vid2vid.py — flow losses iterate both)."""
+        data_t, stacks = self._split_data_t(data)
+        out, new_mut = self._apply_G(vars_G, data_t, rng, training)
+        d_out = self._apply_D(vars_D, data_t, out, stacks, training)
+
+        losses = {}
+        losses["GAN"], losses["FeatureMatching"] = self._gan_fm_losses(
+            d_out["indv"], dis_update=False)
+        if self.perceptual is not None:
+            losses["Perceptual"] = self.perceptual(
+                loss_params["perceptual"], out["fake_images"],
+                data_t["image"])
+        if "L1" in self.weights:
+            losses["L1"] = jnp.mean(jnp.abs(out["fake_images"]
+                                            - data_t["image"]))
+        if self.use_flow:
+            flow_terms = []
+            for warp, occ in zip(out["warped_images"],
+                                 out["fake_occlusion_masks"]):
+                if warp is not None:
+                    flow_terms.append(masked_l1_loss(
+                        out["fake_images"], warp,
+                        jax.lax.stop_gradient(occ)))
+            if flow_terms:
+                losses["Flow"] = sum(flow_terms) / len(flow_terms)
+        for s in range(self.num_temporal_scales):
+            if f"temporal_{s}" in d_out:
+                gan_t, fm_t = self._gan_fm_losses(d_out[f"temporal_{s}"],
+                                                  dis_update=False)
+                losses[f"GAN_T{s}"] = gan_t
+                losses[f"FeatureMatching_T{s}"] = fm_t
+        return losses, new_mut, out
+
+    def dis_forward(self, vars_G, vars_D, loss_params, data, rng,
+                    training=True):
+        data_t, stacks = self._split_data_t(data)
+        out, _ = self._apply_G(vars_G, data_t, rng, training)
+        out = jax.lax.stop_gradient(
+            {k: v for k, v in out.items() if v is not None})
+        d_out, new_mut_D = self._apply_D(vars_D, data_t, out, stacks,
+                                         training, mutable=True)
+        losses = {}
+        losses["GAN"], _ = self._gan_fm_losses(d_out["indv"], dis_update=True)
+        for s in range(self.num_temporal_scales):
+            if f"temporal_{s}" in d_out:
+                gan_t, _ = self._gan_fm_losses(d_out[f"temporal_{s}"],
+                                               dis_update=True)
+                losses[f"GAN_T{s}"] = gan_t
+        return losses, new_mut_D
+
+    def _get_visualizations(self, data):
+        """(ref: trainers/fs_vid2vid.py:196-260)."""
+        data = to_device(numeric_only(dict(data)))
+        variables = self.inference_params()
+        seq_len = (data["images"].shape[1] if data["images"].ndim == 5
+                   else 1)
+        prev_labels = prev_images = None
+        fakes = []
+        for t in range(seq_len):
+            data_t = self._get_data_t(data, t, prev_labels, prev_images)
+            out, _ = self._apply_G(variables, data_t, jax.random.PRNGKey(0),
+                                   training=False)
+            fake = out["fake_images"]
+            fakes.append(fake)
+            prev_labels = concat_frames(prev_labels, data_t["label"],
+                                        self.num_frames_G - 1)
+            prev_images = concat_frames(prev_images, fake,
+                                        self.num_frames_G - 1)
+        image = data["images"][:, -1] if data["images"].ndim == 5 \
+            else data["images"]
+        vis = [data["ref_images"][:, 0], image, fakes[-1]]
+        if out.get("warped_images") and out["warped_images"][0] is not None:
+            vis.append(out["warped_images"][0])
+        return vis

@@ -1,0 +1,98 @@
+"""World-consistent vid2vid trainer
+(ref: imaginaire/trainers/wc_vid2vid.py — vid2vid plus the renderer
+lifecycle: reset per sequence, update the point-cloud colors with every
+generated frame, and feed rendered guidance into the generator).
+
+The SplatRenderer is host-side numpy (ragged point clouds can't live in
+a jitted program); guidance enters each jitted step as a dense
+(B, H, W, 4) tensor and the returned fake frame colors the point cloud
+between steps.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from imaginaire_tpu.config import cfg_get
+from imaginaire_tpu.model_utils.wc_vid2vid import (
+    SplatRenderer,
+    guidance_tensor,
+)
+from imaginaire_tpu.trainers.vid2vid import Trainer as Vid2VidTrainer
+
+
+class Trainer(Vid2VidTrainer):
+    def __init__(self, cfg, *args, **kwargs):
+        super().__init__(cfg, *args, **kwargs)
+        self.renderers = {}  # per batch element
+        self.is_flipped_input = False
+
+    def reset_renderer(self, is_flipped_input=False):
+        """(ref: generators/wc_vid2vid.py:72-80)."""
+        self.renderers = {}
+        self.is_flipped_input = is_flipped_input
+
+    def _renderer(self, b):
+        if b not in self.renderers:
+            self.renderers[b] = SplatRenderer()
+        return self.renderers[b]
+
+    def _point_info(self, data, t, b):
+        """Per-sample (N, 3) pixel->point mapping for frame t, or None.
+        Accepts a nested [batch][frame] list or a stacked (B, T, N, 3)
+        array (the device-upload path converts uniform lists to arrays)."""
+        unproj = data.get("unprojection")
+        if unproj is None:
+            return None
+        entry = unproj[b]
+        if isinstance(entry, (list, tuple)):
+            entry = entry[t] if t < len(entry) else None
+        elif hasattr(entry, "ndim") and entry.ndim >= 3:
+            entry = entry[t] if t < entry.shape[0] else None
+        if entry is None:
+            return None
+        return np.asarray(entry)
+
+    def _get_data_t(self, data, t, prev_labels, prev_images):
+        data_t = super()._get_data_t(data, t, prev_labels, prev_images)
+        label = data_t["label"]
+        b, h, w, _ = label.shape
+        guidance = []
+        any_guidance = False
+        for bi in range(b):
+            info = self._point_info(data, t, bi)
+            if info is not None:
+                any_guidance = True
+                guidance.append(guidance_tensor(
+                    self._renderer(bi), info, w, h,
+                    flipped=self.is_flipped_input))
+            else:
+                guidance.append(np.zeros((h, w, 4), np.float32))
+        if any_guidance:
+            data_t["guidance"] = np.stack(guidance)
+            data_t["_point_infos"] = [self._point_info(data, t, bi)
+                                      for bi in range(b)]
+        return data_t
+
+    def gen_update(self, data):
+        # a new iteration starts a new clip: reset the point cloud
+        # (ref: trainers/wc_vid2vid.py reset path)
+        flipped = data.get("is_flipped")
+        self.reset_renderer(bool(np.any(np.asarray(flipped)))
+                            if flipped is not None else False)
+        return super().gen_update(data)
+
+    def _after_gen_frame(self, data_t, fake):
+        """Color the point cloud with the freshly generated frame."""
+        infos = data_t.get("_point_infos")
+        if not infos:
+            return
+        fake_np = np.asarray(fake)
+        for bi, info in enumerate(infos):
+            if info is None:
+                continue
+            img = ((fake_np[bi] * 0.5 + 0.5) * 255).clip(0, 255).astype(
+                np.uint8)
+            if self.is_flipped_input:
+                img = np.fliplr(img).copy()
+            self._renderer(bi).update_point_cloud(img, info)

@@ -1,0 +1,57 @@
+"""Checkpoint retrieval + misc IO (ref: imaginaire/utils/io.py).
+
+The reference fetches pretrained checkpoints from Google Drive
+(``get_checkpoint(path, drive_id)``). TPU pods usually run with no
+general egress, so resolution order here is: existing local file ->
+$IMAGINAIRE_CHECKPOINT_ROOT mirror -> optional download via
+``gdown``/``urllib`` when the environment allows it -> a loud error
+explaining how to provision the file offline.
+"""
+
+from __future__ import annotations
+
+import os
+
+CHECKPOINT_ROOT_ENV = "IMAGINAIRE_CHECKPOINT_ROOT"
+
+
+def get_checkpoint(checkpoint_path, url_or_id=""):
+    """(ref: io.py get_checkpoint). Returns a local path to the file."""
+    if os.path.exists(checkpoint_path):
+        return checkpoint_path
+    mirror_root = os.environ.get(CHECKPOINT_ROOT_ENV)
+    if mirror_root:
+        mirrored = os.path.join(mirror_root,
+                                os.path.basename(checkpoint_path))
+        if os.path.exists(mirrored):
+            return mirrored
+    if url_or_id:
+        os.makedirs(os.path.dirname(checkpoint_path) or ".", exist_ok=True)
+        try:
+            if url_or_id.startswith("http"):
+                import urllib.request
+
+                urllib.request.urlretrieve(url_or_id, checkpoint_path)
+            else:  # Google Drive file id (the reference's convention)
+                import gdown
+
+                gdown.download(id=url_or_id, output=checkpoint_path,
+                               quiet=False)
+            if os.path.exists(checkpoint_path):
+                return checkpoint_path
+        except Exception as e:  # no egress / missing gdown
+            raise FileNotFoundError(
+                f"Could not download {checkpoint_path!r} ({e}). This "
+                "environment likely has no network egress: provision the "
+                "file manually and either place it at that path or set "
+                f"${CHECKPOINT_ROOT_ENV} to a directory containing it."
+            ) from e
+    raise FileNotFoundError(
+        f"Checkpoint {checkpoint_path!r} not found and no source given; "
+        f"place the file there or set ${CHECKPOINT_ROOT_ENV}.")
+
+
+def save_pilimage_in_jpeg(fullname, output_img):
+    """(ref: io.py save_pilimage_in_jpeg)."""
+    os.makedirs(os.path.dirname(fullname), exist_ok=True)
+    output_img.save(fullname, "JPEG", quality=99)

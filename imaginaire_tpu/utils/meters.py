@@ -29,6 +29,26 @@ def get_summary_writer():
 
 
 @master_only
+def add_hparams(hparam_dict=None, metric_dict=None):
+    """Hyper-parameter dashboard entry (ref: meters.py:81-105): logs the
+    hparams alongside their metrics so TensorBoard's hparams plugin can
+    compare runs."""
+    if _WRITER is None:
+        return
+    if not isinstance(hparam_dict, dict) or not isinstance(metric_dict, dict):
+        raise TypeError("hparam_dict and metric_dict should be dictionaries.")
+    from torch.utils.tensorboard.summary import hparams
+
+    exp, ssi, sei = hparams(hparam_dict, metric_dict)
+    writer = _WRITER._get_file_writer()
+    writer.add_summary(exp)
+    writer.add_summary(ssi)
+    writer.add_summary(sei)
+    for key, value in metric_dict.items():
+        _WRITER.add_scalar(key, value)
+
+
+@master_only
 def write_summary(name, data, step, hist=False):
     """(ref: meters.py:63-78)."""
     if _WRITER is None:

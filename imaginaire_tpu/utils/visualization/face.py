@@ -1,0 +1,222 @@
+"""Facial-landmark label-map rendering
+(ref: imaginaire/utils/visualization/face.py:14-489).
+
+Turns 68-point dlib landmarks into edge-sketch label maps (optionally
+with per-part distance transforms and sinusoidal positional encodings),
+plus keypoint normalization against a reference face. Host-side numpy —
+this runs in the data pipeline as a ``vis::`` post-augmentation op.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from imaginaire_tpu.config import cfg_get
+
+# 68-landmark facial part topology (ref: face.py:46-54); each part is a
+# list of keypoint-index chains to connect.
+FACE_PART_LIST = [
+    [list(range(0, 17))],                                   # contour
+    [list(range(17, 22))],                                  # right eyebrow
+    [list(range(22, 27))],                                  # left eyebrow
+    [[28, 31], list(range(31, 36)), [35, 28]],              # nose
+    [[36, 37, 38, 39], [39, 40, 41, 36]],                   # right eye
+    [[42, 43, 44, 45], [45, 46, 47, 42]],                   # left eye
+    [list(range(48, 55)), [54, 55, 56, 57, 58, 59, 48],
+     list(range(60, 65)), [64, 65, 66, 67, 60]],            # mouth + tongue
+]
+
+
+def _quad(x, a, b, c):
+    return a * x ** 2 + b * x + c
+
+
+def _linear(x, a, b):
+    return a * x + b
+
+
+def interp_points(x, y):
+    """Fit a short curve through the keypoints and rasterize it
+    (ref: face.py:445-481): quadratic fit along the dominant axis,
+    linear for 2-point edges; returns integer coordinate arrays or
+    (None, None) when the fit is degenerate."""
+    x = np.asarray(x, np.float64)
+    y = np.asarray(y, np.float64)
+    if np.abs(np.diff(x)).max(initial=0) < np.abs(np.diff(y)).max(initial=0):
+        curve_y, curve_x = interp_points(y, x)
+        if curve_y is None:
+            return None, None
+        return curve_x, curve_y
+    try:
+        from scipy.optimize import curve_fit
+
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            if len(x) < 3:
+                popt, _ = curve_fit(_linear, x, y)
+                fit = _linear
+            else:
+                popt, _ = curve_fit(_quad, x, y)
+                fit = _quad
+                if abs(popt[0]) > 1:
+                    return None, None
+    except Exception:
+        return None, None
+    if x[0] > x[-1]:
+        x = x[::-1]
+        y = y[::-1]
+    curve_x = np.linspace(x[0], x[-1], int(round(x[-1] - x[0])))
+    curve_y = fit(curve_x, *popt)
+    return curve_x.astype(int), curve_y.astype(int)
+
+
+def set_color(im, yy, xx, color):
+    """(ref: face.py:422-442): new strokes write, crossings average."""
+    if not isinstance(color, (list, tuple)):
+        color = [color] * 3
+    if im.ndim == 3 and im.shape[2] == 3:
+        untouched = (im[yy, xx] == 0).all()
+        if untouched:
+            im[yy, xx] = color
+        else:
+            im[yy, xx] = ((im[yy, xx].astype(float) + color) / 2).astype(
+                np.uint8)
+    else:
+        im[yy, xx] = color[0]
+
+
+def draw_edge(im, x, y, bw=1, color=(255, 255, 255), draw_end_points=False):
+    """Rasterize a curve with a bw-wide stroke (ref: face.py:390-419)."""
+    if x is None or np.size(x) == 0:
+        return
+    h, w = im.shape[:2]
+    for i in range(-bw, bw):
+        for j in range(-bw, bw):
+            yy = np.clip(y + i, 0, h - 1)
+            xx = np.clip(x + j, 0, w - 1)
+            set_color(im, yy, xx, color)
+    if draw_end_points:
+        ends_y = np.array([y[0], y[-1]])
+        ends_x = np.array([x[0], x[-1]])
+        for i in range(-bw * 2, bw * 2):
+            for j in range(-bw * 2, bw * 2):
+                if i ** 2 + j ** 2 < 4 * bw ** 2:
+                    yy = np.clip(ends_y + i, 0, h - 1)
+                    xx = np.clip(ends_x + j, 0, w - 1)
+                    set_color(im, yy, xx, color)
+
+
+def connect_face_keypoints(resize_h, resize_w, crop_h, crop_w, original_h,
+                           original_w, is_flipped, cfgdata, keypoints):
+    """Draw (T, 68[+upper], 2) landmark sequences into per-frame edge
+    label maps (ref: face.py:14-111)."""
+    face_cfg = cfg_get(cfgdata, "for_face_dataset", None)
+    add_upper_face = cfg_get(face_cfg, "add_upper_face", False) \
+        if face_cfg is not None else False
+    add_dist_map = cfg_get(face_cfg, "add_distance_transform", False) \
+        if face_cfg is not None else False
+    add_pos_encode = add_dist_map and cfg_get(
+        face_cfg, "add_positional_encode", False) if face_cfg is not None \
+        else False
+
+    part_list = [list(p) for p in FACE_PART_LIST]
+    keypoints = np.asarray(keypoints, np.float32)
+    if add_upper_face:
+        # mirror the jaw contour above the brow line (ref: face.py:57-63)
+        part_list[0] = [list(range(0, 17)) + list(range(68, 83)) + [0]]
+        pts = keypoints[:, :17].astype(np.int32)
+        baseline_y = (pts[:, 0:1, 1] + pts[:, -1:, 1]) / 2
+        upper = pts[:, 1:-1].copy()
+        upper[:, :, 1] = baseline_y + (baseline_y - upper[:, :, 1]) * 2 // 3
+        keypoints = np.concatenate([keypoints, upper[:, ::-1]], axis=1)
+
+    edge_len = 3
+    bw = max(1, resize_h // 256)
+    outputs = []
+    for t in range(keypoints.shape[0]):
+        im_edges = np.zeros((resize_h, resize_w, 1), np.uint8)
+        im_dists = np.zeros((resize_h, resize_w, 0), np.float32)
+        im_pos = np.zeros((resize_h, resize_w, 0), np.float32)
+        for part in part_list:
+            for e, edge in enumerate(part):
+                edge = list(edge)
+                im_edge = np.zeros((resize_h, resize_w, 1), np.uint8)
+                for i in range(0, max(1, len(edge) - 1), edge_len - 1):
+                    sub = edge[i:i + edge_len]
+                    cx, cy = interp_points(keypoints[t, sub, 0],
+                                           keypoints[t, sub, 1])
+                    draw_edge(im_edges, cx, cy, bw=bw)
+                    if add_dist_map:
+                        draw_edge(im_edge, cx, cy, bw=bw)
+                if add_dist_map:
+                    im_dist = _distance_transform(255 - im_edge[..., 0])
+                    im_dist = np.clip(im_dist / 3, 0, 255)
+                    im_dists = np.dstack([im_dists, im_dist])
+                    if add_pos_encode and e == 0:
+                        im_pos = np.zeros((resize_h, resize_w, 0), np.float32)
+                        dist = (im_dist - 127.5) / 127.5
+                        for level in range(10):
+                            phase = np.pi * (2 ** level) * dist
+                            im_pos = np.dstack([im_pos, np.sin(phase),
+                                                np.cos(phase)])
+        label = im_edges.astype(np.float32)
+        if add_dist_map:
+            label = np.dstack([label, im_dists])
+        label = label / 255.0
+        if add_pos_encode:
+            label = np.dstack([label, im_pos])
+        outputs.append(label)
+    return outputs
+
+
+def _distance_transform(binary):
+    """L1 distance to the nearest zero pixel; cv2 when present, else a
+    two-pass chamfer sweep (same metric, pure numpy)."""
+    try:
+        import cv2
+
+        return cv2.distanceTransform(binary.astype(np.uint8), cv2.DIST_L1, 3)
+    except ImportError:
+        h, w = binary.shape
+        inf = h + w
+        d = np.where(binary == 0, 0, inf).astype(np.int32)
+        for i in range(h):
+            for j in range(w):
+                if i > 0:
+                    d[i, j] = min(d[i, j], d[i - 1, j] + 1)
+                if j > 0:
+                    d[i, j] = min(d[i, j], d[i, j - 1] + 1)
+        for i in range(h - 1, -1, -1):
+            for j in range(w - 1, -1, -1):
+                if i < h - 1:
+                    d[i, j] = min(d[i, j], d[i + 1, j] + 1)
+                if j < w - 1:
+                    d[i, j] = min(d[i, j], d[i, j + 1] + 1)
+        return d.astype(np.float32)
+
+
+def normalize_face_keypoints(keypoints, ref_keypoints, dist_scales=None,
+                             momentum=0.9):
+    """Scale each facial part of ``keypoints`` toward the reference
+    face's part proportions (ref: face.py:197-268, simplified to the
+    part-centroid scaling that drives few-shot face reenactment)."""
+    keypoints = np.asarray(keypoints, np.float32).copy()
+    ref_keypoints = np.asarray(ref_keypoints, np.float32)
+    new_scales = []
+    for part in FACE_PART_LIST:
+        idx = sorted({i for chain in part for i in chain if i < 68})
+        pts = keypoints[idx]
+        ref = ref_keypoints[idx]
+        center = pts.mean(axis=0, keepdims=True)
+        ref_center = ref.mean(axis=0, keepdims=True)
+        spread = np.linalg.norm(pts - center, axis=1).mean() + 1e-6
+        ref_spread = np.linalg.norm(ref - ref_center, axis=1).mean() + 1e-6
+        scale = ref_spread / spread
+        new_scales.append(scale)
+        keypoints[idx] = center + (pts - center) * scale
+    if dist_scales is not None:
+        new_scales = [momentum * o + (1 - momentum) * n
+                      for o, n in zip(dist_scales, new_scales)]
+    return keypoints, new_scales

@@ -1,0 +1,202 @@
+"""OpenPose skeleton label-map rendering
+(ref: imaginaire/utils/visualization/pose.py:14-342).
+
+Converts OpenPose JSON keypoints (body 25 + hands + face) into colored
+or one-hot skeleton label maps, used as a ``vis::`` post-aug op by the
+pose-driven vid2vid projects.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from imaginaire_tpu.config import cfg_get
+from imaginaire_tpu.utils.visualization.face import draw_edge, interp_points
+
+
+def define_edge_lists(basic_points_only=False):
+    """Keypoint connectivity + stroke colors (ref: pose.py:281-339)."""
+    pose_edge_list = [
+        [17, 15], [15, 0], [0, 16], [16, 18],   # head
+        [0, 1], [1, 8],                         # torso
+        [1, 2], [2, 3], [3, 4],                 # right arm
+        [1, 5], [5, 6], [6, 7],                 # left arm
+        [8, 9], [9, 10], [10, 11],              # right leg
+        [8, 12], [12, 13], [13, 14],            # left leg
+    ]
+    pose_color_list = [
+        [153, 0, 153], [153, 0, 102], [102, 0, 153], [51, 0, 153],
+        [153, 0, 51], [153, 0, 0],
+        [153, 51, 0], [153, 102, 0], [153, 153, 0],
+        [102, 153, 0], [51, 153, 0], [0, 153, 0],
+        [0, 153, 51], [0, 153, 102], [0, 153, 153],
+        [0, 102, 153], [0, 51, 153], [0, 0, 153],
+    ]
+    if not basic_points_only:
+        pose_edge_list += [[11, 24], [11, 22], [22, 23],
+                           [14, 21], [14, 19], [19, 20]]  # feet
+        pose_color_list += [[0, 153, 153]] * 3 + [[0, 0, 153]] * 3
+    hand_edge_list = [[0, 1, 2, 3, 4], [0, 5, 6, 7, 8], [0, 9, 10, 11, 12],
+                      [0, 13, 14, 15, 16], [0, 17, 18, 19, 20]]
+    hand_color_list = [[204, 0, 0], [163, 204, 0], [0, 204, 82],
+                       [0, 82, 204], [163, 0, 204]]
+    face_list = [
+        [list(range(0, 17))],
+        [list(range(17, 22))],
+        [list(range(22, 27))],
+        [[28, 31], list(range(31, 36)), [35, 28]],
+        [[36, 37, 38, 39], [39, 40, 41, 36]],
+        [[42, 43, 44, 45], [45, 46, 47, 42]],
+        [list(range(48, 55)), [54, 55, 56, 57, 58, 59, 48]],
+    ]
+    return (pose_edge_list, pose_color_list, hand_edge_list, hand_color_list,
+            face_list)
+
+
+def extract_valid_keypoints(pts, edge_lists):
+    """Zero out keypoints below the confidence threshold
+    (ref: pose.py:144-174). pts: dict of 'pose'/'face'/'hand_l'/'hand_r'
+    (N, 3) arrays."""
+    thresholds = {"pose": 0.15, "face": 0.5, "hand_l": 0.3, "hand_r": 0.3}
+    out = []
+    for name in ("pose", "face", "hand_l", "hand_r"):
+        p = np.asarray(pts.get(name, np.zeros((0, 3))), np.float32)
+        if p.size:
+            valid = p[:, 2] > thresholds[name]
+            p = p[:, :2] * valid[:, None]
+        else:
+            p = np.zeros((0, 2), np.float32)
+        out.append(p)
+    return out
+
+
+def draw_edges(canvas, keypoints, edges_list, bw, use_one_hot,
+               random_drop_prob=0, edge_len=2, colors=None,
+               draw_end_points=False):
+    """(ref: pose.py:237-278)."""
+    k = 0
+    for edge_list in edges_list:
+        for i, edge in enumerate(edge_list):
+            for j in range(0, max(1, len(edge) - 1), edge_len - 1):
+                if random.random() > random_drop_prob:
+                    sub = list(edge)[j:j + edge_len]
+                    x, y = keypoints[sub, 0], keypoints[sub, 1]
+                    if 0 not in x:  # zeroed keypoints are invalid
+                        cx, cy = interp_points(x, y)
+                        if use_one_hot:
+                            draw_edge(canvas[:, :, k], cx, cy, bw=bw,
+                                      color=255,
+                                      draw_end_points=draw_end_points)
+                        else:
+                            color = (colors[i] if colors is not None
+                                     else (255, 255, 255))
+                            draw_edge(canvas, cx, cy, bw=bw, color=color,
+                                      draw_end_points=draw_end_points)
+            k += 1
+    return canvas
+
+
+def connect_pose_keypoints(pts, edge_lists, size, basic_points_only=False,
+                           remove_face_labels=False, random_drop_prob=0.0):
+    """(ref: pose.py:177-234)."""
+    pose_pts, face_pts, hand_pts_l, hand_pts_r = pts
+    h, w, c = size
+    canvas = np.zeros((h, w, c), np.uint8)
+    use_one_hot = c > 3
+    (pose_edge_list, pose_color_list, hand_edge_list, hand_color_list,
+     face_list) = edge_lists
+
+    span = int(pose_pts[:, 1].max() - pose_pts[:, 1].min()) \
+        if pose_pts.size else h
+    bw = max(1, span // 150)
+    canvas = draw_edges(canvas, pose_pts, [pose_edge_list], bw, use_one_hot,
+                        random_drop_prob, colors=pose_color_list,
+                        draw_end_points=True)
+    if not basic_points_only:
+        bw = max(1, span // 450)
+        for i, hand_pts in enumerate([hand_pts_l, hand_pts_r]):
+            if hand_pts.size:
+                if use_one_hot:
+                    k = 24 + i
+                    draw_edges(canvas[:, :, k], hand_pts, [hand_edge_list],
+                               bw, False, random_drop_prob,
+                               colors=[255] * len(hand_edge_list))
+                else:
+                    draw_edges(canvas, hand_pts, [hand_edge_list], bw, False,
+                               random_drop_prob, colors=hand_color_list)
+        if not remove_face_labels and face_pts.size:
+            if use_one_hot:
+                draw_edges(canvas[:, :, 26], face_pts, face_list, bw, False,
+                           random_drop_prob)
+            else:
+                draw_edges(canvas, face_pts, face_list, bw, False,
+                           random_drop_prob)
+    return canvas
+
+
+def openpose_to_npy(inputs, return_largest_only=False):
+    """Decode OpenPose JSON dicts into per-person keypoint arrays
+    (ref: pose.py:75-141). Returns the dict for the largest person when
+    requested (multi-person frames pick the tallest skeleton)."""
+    people = inputs.get("people", []) if isinstance(inputs, dict) else inputs
+    decoded = []
+    for person in people:
+        entry = {
+            "pose": np.asarray(person.get("pose_keypoints_2d", []),
+                               np.float32).reshape(-1, 3),
+            "face": np.asarray(person.get("face_keypoints_2d", []),
+                               np.float32).reshape(-1, 3),
+            "hand_l": np.asarray(person.get("hand_left_keypoints_2d", []),
+                                 np.float32).reshape(-1, 3),
+            "hand_r": np.asarray(person.get("hand_right_keypoints_2d", []),
+                                 np.float32).reshape(-1, 3),
+        }
+        decoded.append(entry)
+    if not decoded:
+        return None
+    if return_largest_only:
+        def height(e):
+            valid = e["pose"][e["pose"][:, 2] > 0.1]
+            return float(np.ptp(valid[:, 1])) if valid.size else 0.0
+
+        return max(decoded, key=height)
+    return decoded
+
+
+def openpose_to_npy_largest_only(inputs):
+    """(ref: pose.py:75-85)."""
+    return openpose_to_npy(inputs, return_largest_only=True)
+
+
+def draw_openpose_npy(resize_h, resize_w, crop_h, crop_w, original_h,
+                      original_w, is_flipped, cfgdata, keypoints_npy):
+    """Render decoded OpenPose keypoints to label maps per frame
+    (ref: pose.py:14-72)."""
+    pose_cfg = cfg_get(cfgdata, "for_pose_dataset", None)
+    basic_points_only = cfg_get(pose_cfg, "basic_points_only", False) \
+        if pose_cfg is not None else False
+    remove_face_labels = cfg_get(pose_cfg, "remove_face_labels", False) \
+        if pose_cfg is not None else False
+    random_drop_prob = cfg_get(pose_cfg, "random_drop_prob", 0.0) \
+        if pose_cfg is not None else 0.0
+    use_one_hot = cfg_get(pose_cfg, "pose_one_hot", False) \
+        if pose_cfg is not None else False
+
+    edge_lists = define_edge_lists(basic_points_only)
+    c = 27 if use_one_hot else 3
+    outputs = []
+    for frame in keypoints_npy:
+        if frame is None:
+            outputs.append(np.zeros((resize_h, resize_w, c), np.float32))
+            continue
+        pts = extract_valid_keypoints(frame, edge_lists)
+        # keypoints were already co-transformed (resize/crop/flip) by the
+        # augmentor — they arrive in canvas coordinates; rescaling again
+        # (as the reference does for raw keypoints) would misalign them
+        label = connect_pose_keypoints(
+            pts, edge_lists, (resize_h, resize_w, c), basic_points_only,
+            remove_face_labels, random_drop_prob)
+        outputs.append(label.astype(np.float32) / 255.0)
+    return outputs

@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""Dataset builder CLI (ref: scripts/build_lmdb.py:40-125).
+
+Packs a raw folder tree into the framework's packed binary shards —
+the TPU-native replacement for the reference's LMDB build step:
+
+    python scripts/build_dataset.py --data_root raw/ --output_root packed/ \
+        --input_types images,seg_maps
+
+The packed layout (data.bin + index.json per type + all_filenames.json)
+is read by PackedBackend (configs set ``is_packed: True``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+from imaginaire_tpu.data.backends import build_packed_dataset  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--data_root", required=True)
+    parser.add_argument("--output_root", required=True)
+    parser.add_argument("--input_types", required=True,
+                        help="comma-separated data type folder names")
+    args = parser.parse_args()
+    out = build_packed_dataset(args.data_root, args.output_root,
+                               [t.strip() for t in
+                                args.input_types.split(",")])
+    print(f"Packed dataset written to {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Auxiliary components: PartialSequential, class-conditional images
+dataset, checkpoint IO gating, hparams writer, profiler hook config."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from imaginaire_tpu.config import AttrDict, Config
+
+
+class TestPartialSequential:
+    def test_threads_mask_through_partial_convs(self, rng):
+        from imaginaire_tpu.layers import PartialConv2dBlock, PartialSequential
+
+        class Net(__import__("flax").linen.Module):
+            def setup(self):
+                self.seq = PartialSequential(layers=(
+                    PartialConv2dBlock(4, kernel_size=3),
+                    PartialConv2dBlock(2, kernel_size=3),
+                ))
+
+            def __call__(self, x):
+                return self.seq(x)
+
+        x = jnp.asarray(rng.rand(1, 8, 8, 3).astype(np.float32))
+        mask = jnp.zeros((1, 8, 8, 1))
+        mask = mask.at[:, 2:6, 2:6].set(1.0)
+        net = Net()
+        v = net.init(jax.random.PRNGKey(0), jnp.concatenate([x, mask], -1))
+        out = net.apply(v, jnp.concatenate([x, mask], -1))
+        assert out.shape == (1, 8, 8, 2)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+
+class TestImagesDataset:
+    def test_class_mapping(self):
+        cfg = AttrDict({
+            "data": {
+                "name": "cls", "type": "imaginaire_tpu.data.images",
+                "input_types": [
+                    {"images": {"ext": "jpg", "num_channels": 3,
+                                "interpolator": "BILINEAR",
+                                "normalize": True}}],
+                "input_image": ["images"],
+                "train": {"roots": ["tests/fixtures/fewshot/raw"],
+                          "batch_size": 1,
+                          "augmentations": {"resize_h_w": "32, 32"}},
+                "val": {"roots": ["tests/fixtures/fewshot/raw"],
+                        "batch_size": 1,
+                        "augmentations": {"resize_h_w": "32, 32"}},
+            }})
+        # the fewshot fixture root has images_content/images_style dirs;
+        # point input_types at one of them
+        cfg.data.input_types[0] = AttrDict(
+            {"images_content": {"ext": "jpg", "num_channels": 3,
+                                "interpolator": "BILINEAR",
+                                "normalize": True}})
+        cfg.data.input_image = ["images_content"]
+        from imaginaire_tpu.registry import resolve
+
+        ds = resolve(cfg.data.type, "Dataset")(cfg)
+        assert ds.num_classes == 2  # cat, dog
+        item = ds[0]
+        assert item["images_content"].shape == (32, 32, 3)
+        assert 0 <= int(item["labels"]) < 2
+        ds.set_sample_class_idx(1)
+        item = ds[0]
+        assert len(ds) == 2
+
+
+class TestCheckpointIO:
+    def test_local_file_passthrough(self, tmp_path):
+        from imaginaire_tpu.utils.io import get_checkpoint
+
+        p = tmp_path / "model.ckpt"
+        p.write_text("x")
+        assert get_checkpoint(str(p)) == str(p)
+
+    def test_mirror_env(self, tmp_path, monkeypatch):
+        from imaginaire_tpu.utils import io
+
+        mirror = tmp_path / "mirror"
+        mirror.mkdir()
+        (mirror / "model.ckpt").write_text("x")
+        monkeypatch.setenv(io.CHECKPOINT_ROOT_ENV, str(mirror))
+        assert io.get_checkpoint(str(tmp_path / "nope" / "model.ckpt")) == \
+            str(mirror / "model.ckpt")
+
+    def test_missing_raises_loudly(self, tmp_path):
+        from imaginaire_tpu.utils.io import get_checkpoint
+
+        with pytest.raises(FileNotFoundError):
+            get_checkpoint(str(tmp_path / "absent.ckpt"))
+
+
+class TestHparams:
+    def test_add_hparams_writes(self, tmp_path):
+        from imaginaire_tpu.utils import meters
+
+        meters.set_summary_writer(str(tmp_path))
+        meters.add_hparams({"lr": 1e-4, "bs": 4}, {"metrics/fid": 12.3})
+        assert any(os.listdir(str(tmp_path)))
+        with pytest.raises(TypeError):
+            meters.add_hparams(None, None)

@@ -1,0 +1,159 @@
+"""Face/pose keypoint rendering + the vis:: data-pipeline grammar."""
+
+import json
+import os
+
+import numpy as np
+
+from imaginaire_tpu.config import Config
+from imaginaire_tpu.registry import resolve
+from imaginaire_tpu.utils.visualization.face import (
+    connect_face_keypoints,
+    interp_points,
+    normalize_face_keypoints,
+)
+from imaginaire_tpu.utils.visualization.pose import (
+    connect_pose_keypoints,
+    define_edge_lists,
+    draw_openpose_npy,
+    openpose_to_npy_largest_only,
+)
+
+HERE = os.path.dirname(__file__)
+
+
+def synthetic_face(seed=0):
+    rng = np.random.RandomState(seed)
+    t = np.linspace(0, np.pi, 17)
+    jaw = np.stack([24 + 80 * t / np.pi, 40 + 50 * np.sin(t)], 1)
+    rest = rng.rand(51, 2) * np.array([60, 40]) + np.array([34, 30])
+    return np.concatenate([jaw, rest])[None]  # (1, 68, 2)
+
+
+class TestFaceRendering:
+    def test_connect_face_keypoints_draws(self):
+        from imaginaire_tpu.config import AttrDict
+
+        cfg = AttrDict({})
+        out = connect_face_keypoints(128, 128, 128, 128, 128, 128, False,
+                                     cfg, synthetic_face())
+        assert len(out) == 1
+        assert out[0].shape == (128, 128, 1)
+        assert out[0].max() == 1.0  # something was drawn
+        assert out[0].min() == 0.0
+
+    def test_distance_transform_channels(self):
+        from imaginaire_tpu.config import AttrDict
+
+        cfg = AttrDict({"for_face_dataset": {
+            "add_upper_face": True, "add_distance_transform": True}})
+        out = connect_face_keypoints(64, 64, 64, 64, 64, 64, False, cfg,
+                                     synthetic_face())
+        # 1 edge channel + one distance channel per drawn part-edge
+        assert out[0].shape[-1] > 1
+
+    def test_interp_points_line(self):
+        x, y = interp_points(np.array([0, 10]), np.array([0, 10]))
+        assert x is not None and len(x) == 10
+        np.testing.assert_allclose(x, y)
+
+    def test_normalize_face_keypoints_matches_scale(self):
+        kp = synthetic_face()[0]
+        ref = kp * 2.0
+        out, scales = normalize_face_keypoints(kp, ref)
+        assert out.shape == kp.shape
+        # parts scaled up toward the reference spread
+        assert all(s > 1.5 for s in scales)
+
+
+class TestPoseRendering:
+    def _person(self, rng):
+        return {
+            "pose_keypoints_2d": (rng.rand(25, 3) * np.array([64, 64, 1])
+                                  + np.array([1, 1, 0.5])).ravel().tolist(),
+            "face_keypoints_2d": (rng.rand(70, 3) * np.array([64, 64, 1])
+                                  + np.array([1, 1, 0.6])).ravel().tolist(),
+            "hand_left_keypoints_2d": (rng.rand(21, 3)
+                                       * np.array([64, 64, 1])
+                                       + np.array([1, 1, 0.5])
+                                       ).ravel().tolist(),
+            "hand_right_keypoints_2d": (rng.rand(21, 3)
+                                        * np.array([64, 64, 1])
+                                        + np.array([1, 1, 0.5])
+                                        ).ravel().tolist(),
+        }
+
+    def test_draw_openpose_rgb(self):
+        from imaginaire_tpu.config import AttrDict
+
+        rng = np.random.RandomState(0)
+        frames = [openpose_to_npy_largest_only({"people": [self._person(rng)]})]
+        out = draw_openpose_npy(64, 64, 64, 64, 64, 64, False,
+                                AttrDict({}), frames)
+        assert out[0].shape == (64, 64, 3)
+        assert out[0].max() > 0
+
+    def test_one_hot_channels(self):
+        from imaginaire_tpu.config import AttrDict
+
+        rng = np.random.RandomState(0)
+        frames = [openpose_to_npy_largest_only({"people": [self._person(rng)]})]
+        cfg = AttrDict({"for_pose_dataset": {"pose_one_hot": True}})
+        out = draw_openpose_npy(64, 64, 64, 64, 64, 64, False, cfg, frames)
+        assert out[0].shape == (64, 64, 27)
+
+    def test_largest_person_selected(self):
+        rng = np.random.RandomState(0)
+        small = self._person(rng)
+        big = self._person(rng)
+        big["pose_keypoints_2d"] = (np.array(
+            big["pose_keypoints_2d"]).reshape(25, 3)
+            * np.array([1, 3, 1])).ravel().tolist()
+        out = openpose_to_npy_largest_only({"people": [small, big]})
+        np.testing.assert_allclose(
+            out["pose"].ravel(),
+            np.array(big["pose_keypoints_2d"]).reshape(25, 3).ravel())
+
+
+class TestVisOpPipeline:
+    def test_face_dataset_via_vis_op(self):
+        """keypoints load as JSON, decode in pre-aug, co-transform in the
+        augmentor, and render into label maps via the vis:: post-aug op —
+        the reference's face data pipeline end to end."""
+        cfg = Config(os.path.join(HERE, "..", "configs", "unit_test",
+                                  "spade.yaml"))
+        cfg.data = type(cfg.data)({
+            "name": "face_tiny",
+            "type": "imaginaire_tpu.data.paired_videos",
+            "num_frames_G": 2,
+            "num_workers": 0,
+            "input_types": [
+                {"images": {"ext": "jpg", "num_channels": 3,
+                            "interpolator": "BILINEAR", "normalize": True}},
+                {"landmarks-dlib68": {
+                    "ext": "json", "num_channels": 1,
+                    "interpolator": "NEAREST", "normalize": False,
+                    "pre_aug_ops": "decode_json,to_numpy",
+                    "post_aug_ops": "vis::imaginaire_tpu.utils.visualization"
+                                    ".face::connect_face_keypoints"}},
+            ],
+            "input_image": ["images"],
+            "input_labels": ["landmarks-dlib68"],
+            "keypoint_data_types": ["landmarks-dlib68"],
+            "train": {"roots": [os.path.join(HERE, "fixtures", "face",
+                                             "raw")],
+                      "batch_size": 1,
+                      "initial_sequence_length": 2,
+                      "augmentations": {"resize_h_w": "64, 64",
+                                        "horizontal_flip": False}},
+            "val": {"roots": [os.path.join(HERE, "fixtures", "face", "raw")],
+                    "batch_size": 1,
+                    "augmentations": {"resize_h_w": "64, 64",
+                                      "horizontal_flip": False}},
+        })
+        ds = resolve(cfg.data.type, "Dataset")(cfg)
+        item = ds[0]
+        assert item["images"].shape == (2, 64, 64, 3)
+        # keypoints rendered into a 1-channel edge map at the crop size
+        assert item["label"].shape == (2, 64, 64, 1)
+        assert item["label"].max() > 0

@@ -105,6 +105,32 @@ class TestVid2VidTraining:
         assert out2["fake_occlusion_masks"].shape == (1, 64, 64, 1)
         assert out2["warped_images"].shape == (1, 64, 64, 3)
 
+    def test_flownet_teacher_wiring(self, rng, tmp_path):
+        """cfg.flow_network activates the FlowNet2-teacher FlowLoss path:
+        weights registered, teacher params in loss_params, and the
+        teacher-driven loss terms compute on real data shapes."""
+        import jax.numpy as jnp
+
+        from imaginaire_tpu.losses.flow import FlowLoss
+
+        cfg = Config(CFG)
+        cfg.logdir = str(tmp_path)
+        cfg.flow_network = {"allow_random_init": True}
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        assert trainer.flow_net_wrapper is not None
+        assert {"Flow_L1", "Flow_Warp", "Flow_Mask"} <= set(trainer.weights)
+        # FlowLoss consumes the teacher's (flow, conf) on vid2vid outputs
+        a = jnp.asarray(rng.rand(1, 64, 64, 3).astype(np.float32))
+        b = jnp.asarray(rng.rand(1, 64, 64, 3).astype(np.float32))
+        fl = FlowLoss(trainer.flow_net_wrapper)
+        out = {"fake_images": a,
+               "warped_images": b,
+               "fake_flow_maps": jnp.zeros((1, 64, 64, 2)),
+               "fake_occlusion_masks": jnp.full((1, 64, 64, 1), 0.5)}
+        l1, warp, mask = fl({"image": a, "real_prev_image": b}, out)
+        for v in (l1, warp, mask):
+            assert np.isfinite(float(v))
+
     def test_curriculum_epoch_schedule(self, rng, tmp_path):
         cfg = Config(CFG)
         cfg.logdir = str(tmp_path)
