@@ -114,10 +114,203 @@ class AlexNetFeatures(nn.Module):
         return out
 
 
+class VGGFaceFeatures(nn.Module):
+    """vgg_face_dag: VGG16 trunk + 7x7 avgpool + fc6/fc7/fc8 classifier
+    taps — the only layers the reference exposes for this network
+    (ref: perceptual.py:299-358: avgpool, fc6, relu_6, fc7, relu_7, fc8).
+    Conv weights come from the vgg_face_dag checkpoint converted into the
+    vgg16 layout (scripts/convert_weights.py vgg_face_dag)."""
+
+    capture: tuple = ("fc7",)
+
+    @nn.compact
+    def __call__(self, x):
+        out = {}
+
+        def tap(name, val):
+            if name in self.capture:
+                out[name] = val
+
+        conv_i = 0
+        for v in _VGG16_CFG:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+                continue
+            x = nn.relu(nn.Conv(v, (3, 3), padding=1,
+                                name=f"conv_{conv_i}")(x))
+            conv_i += 1
+        b, h, w, c = x.shape
+        x = jax.image.resize(x, (b, 7, 7, c), "bilinear") \
+            if (h, w) != (7, 7) else x  # AdaptiveAvgPool2d((7, 7))
+        tap("avgpool", x)
+        # torch flattens NCHW -> (B, C*7*7); transpose so ported fc6
+        # weights line up
+        x = jnp.transpose(x, (0, 3, 1, 2)).reshape(b, -1)
+        x = nn.Dense(4096, name="fc6")(x)
+        tap("fc6", x)
+        x = nn.relu(x)
+        tap("relu_6", x)
+        x = nn.Dense(4096, name="fc7")(x)
+        tap("fc7", x)
+        x = nn.relu(x)
+        tap("relu_7", x)
+        x = nn.Dense(2622, name="fc8")(x)
+        tap("fc8", x)
+        return out
+
+
+class InceptionFeatures(nn.Module):
+    """Inception-v3 trunk with perceptual taps
+    (ref: perceptual.py:227-253: pool_1, pool_2, mixed_6e, pool_3).
+    Reuses the evaluation package's blocks, so the FID weight port
+    (weights/inception_v3.npz) drives this loss too."""
+
+    capture: tuple = ("pool_3",)
+
+    _ORDER = ("pool_1", "pool_2", "mixed_6e", "pool_3")
+
+    def _deepest(self, name):
+        """True when no requested tap lies beyond ``name`` — the deeper
+        (unused) trunk params are then never created (same early exit as
+        VGGFeatures)."""
+        idx = self._ORDER.index(name)
+        return all(self._ORDER.index(c) <= idx for c in self.capture
+                   if c in self._ORDER)
+
+    @nn.compact
+    def __call__(self, x):
+        from imaginaire_tpu.evaluation.inception import (
+            BasicConv,
+            InceptionA,
+            InceptionB,
+            InceptionC,
+            InceptionD,
+            InceptionE,
+            _max_pool3s2,
+        )
+
+        out = {}
+
+        def tap(name, val):
+            if name in self.capture:
+                out[name] = val
+
+        x = BasicConv(32, (3, 3), stride=(2, 2), name="Conv2d_1a_3x3")(x)
+        x = BasicConv(32, (3, 3), name="Conv2d_2a_3x3")(x)
+        x = BasicConv(64, (3, 3), padding=((1, 1), (1, 1)),
+                      name="Conv2d_2b_3x3")(x)
+        x = _max_pool3s2(x)
+        tap("pool_1", x)
+        if self._deepest("pool_1"):
+            return out
+        x = BasicConv(80, (1, 1), name="Conv2d_3b_1x1")(x)
+        x = BasicConv(192, (3, 3), name="Conv2d_4a_3x3")(x)
+        x = _max_pool3s2(x)
+        tap("pool_2", x)
+        if self._deepest("pool_2"):
+            return out
+        x = InceptionA(32, name="Mixed_5b")(x)
+        x = InceptionA(64, name="Mixed_5c")(x)
+        x = InceptionA(64, name="Mixed_5d")(x)
+        x = InceptionB(name="Mixed_6a")(x)
+        x = InceptionC(128, name="Mixed_6b")(x)
+        x = InceptionC(160, name="Mixed_6c")(x)
+        x = InceptionC(160, name="Mixed_6d")(x)
+        x = InceptionC(192, name="Mixed_6e")(x)
+        tap("mixed_6e", x)
+        if self._deepest("mixed_6e"):
+            return out
+        x = InceptionD(name="Mixed_7a")(x)
+        x = InceptionE(name="Mixed_7b")(x)
+        x = InceptionE(name="Mixed_7c")(x)
+        tap("pool_3", jnp.mean(x, axis=(1, 2), keepdims=True))
+        return out
+
+
+class _FrozenBN(nn.Module):
+    """Inference-only BatchNorm with running stats as parameters (the
+    torchvision-eval semantics; matches evaluation.inception.BasicConv)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.features
+        scale = self.param("scale", nn.initializers.ones, (c,))
+        bias = self.param("bias", nn.initializers.zeros, (c,))
+        mean = self.param("mean", nn.initializers.zeros, (c,))
+        var = self.param("var", nn.initializers.ones, (c,))
+        return (x - mean) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+
+
+class Bottleneck(nn.Module):
+    """ResNet bottleneck (torchvision layout, frozen BN)."""
+
+    features: int
+    stride: int = 1
+    downsample: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        identity = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False, name="conv1")(x)
+        y = nn.relu(_FrozenBN(self.features, name="bn1")(y))
+        y = nn.Conv(self.features, (3, 3),
+                    strides=(self.stride, self.stride),
+                    padding=((1, 1), (1, 1)), use_bias=False, name="conv2")(y)
+        y = nn.relu(_FrozenBN(self.features, name="bn2")(y))
+        y = nn.Conv(self.features * 4, (1, 1), use_bias=False,
+                    name="conv3")(y)
+        y = _FrozenBN(self.features * 4, name="bn3")(y)
+        if self.downsample:
+            identity = nn.Conv(self.features * 4, (1, 1),
+                               strides=(self.stride, self.stride),
+                               use_bias=False, name="downsample_conv")(x)
+            identity = _FrozenBN(self.features * 4,
+                                 name="downsample_bn")(identity)
+        return nn.relu(y + identity)
+
+
+class ResNet50Features(nn.Module):
+    """torchvision resnet50 trunk with taps layer_1..layer_4
+    (ref: perceptual.py:256-272; robust_resnet50 shares the arch and
+    differs only in the converted weight file, ref: perceptual.py:275-297)."""
+
+    capture: tuple = ("layer_4",)
+
+    @nn.compact
+    def __call__(self, x):
+        out = {}
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=((3, 3), (3, 3)),
+                    use_bias=False, name="conv1")(x)
+        x = nn.relu(_FrozenBN(64, name="bn1")(x))
+        x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)),
+                    constant_values=-1e30)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        deepest = max((int(c.split("_")[1]) for c in self.capture
+                       if c.startswith("layer_")), default=4)
+        for li, (blocks, feats) in enumerate(
+                [(3, 64), (4, 128), (6, 256), (3, 512)], start=1):
+            for bi in range(blocks):
+                stride = 2 if (bi == 0 and li > 1) else 1
+                x = Bottleneck(feats, stride=stride, downsample=(bi == 0),
+                               name=f"layer{li}_{bi}")(x)
+            if f"layer_{li}" in self.capture:
+                out[f"layer_{li}"] = x
+            if li >= deepest:
+                break
+        return out
+
+
 _NETWORKS = {
     "vgg19": lambda capture: VGGFeatures(cfg=_VGG19_CFG, capture=tuple(capture)),
     "vgg16": lambda capture: VGGFeatures(cfg=_VGG16_CFG, capture=tuple(capture)),
+    "vgg_face_dag": lambda capture: VGGFaceFeatures(capture=tuple(capture)),
     "alexnet": lambda capture: AlexNetFeatures(capture=tuple(capture)),
+    "inception_v3": lambda capture: InceptionFeatures(capture=tuple(capture)),
+    "resnet50": lambda capture: ResNet50Features(capture=tuple(capture)),
+    "robust_resnet50": lambda capture: ResNet50Features(
+        capture=tuple(capture)),
 }
 
 
@@ -155,8 +348,7 @@ class PerceptualLoss:
         if network not in _NETWORKS:
             raise ValueError(
                 f"Network {network!r} is not implemented (available: "
-                f"{sorted(_NETWORKS)}; inception_v3/resnet50 live in "
-                f"imaginaire_tpu.evaluation once ported).")
+                f"{sorted(_NETWORKS)}).")
         self.network_name = network
         self.layers = list(layers)
         self.weights = list(weights)
@@ -169,10 +361,15 @@ class PerceptualLoss:
         if weights_path is None:
             import os
 
-            weights_path = os.path.join(
-                os.path.dirname(os.path.dirname(os.path.dirname(
-                    os.path.abspath(__file__)))),
-                "weights", f"{network}_features.npz")
+            root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            if network == "inception_v3":
+                # share the FID port (weights/inception_v3.npz)
+                weights_path = os.path.join(root, "weights",
+                                            "inception_v3.npz")
+            else:
+                weights_path = os.path.join(root, "weights",
+                                            f"{network}_features.npz")
         self.weights_path = weights_path
         self.module = _NETWORKS[network](self.layers)
 
@@ -183,8 +380,18 @@ class PerceptualLoss:
 
         if os.path.exists(self.weights_path):
             if self.network_name in ("vgg19", "vgg16"):
-                return load_torch_vgg_weights(self.weights_path, self.network_name)
-            return load_torch_alexnet_weights(self.weights_path)
+                return load_torch_vgg_weights(self.weights_path,
+                                              self.network_name)
+            if self.network_name == "vgg_face_dag":
+                return load_torch_vgg_face_weights(self.weights_path)
+            if self.network_name == "alexnet":
+                return load_torch_alexnet_weights(self.weights_path)
+            if self.network_name == "inception_v3":
+                from imaginaire_tpu.evaluation.inception import load_params
+
+                return load_params(self.weights_path)["params"]
+            if self.network_name in ("resnet50", "robust_resnet50"):
+                return load_torch_resnet50_weights(self.weights_path)
         if self.allow_random_init:
             dummy = jnp.zeros((1, image_hw[0], image_hw[1], 3))
             return self.module.init(key, dummy)["params"]
@@ -261,5 +468,70 @@ def load_torch_alexnet_weights(npz_path):
         params[f"conv_{k}"] = {
             "kernel": jnp.asarray(np.transpose(w, (2, 3, 1, 0))),
             "bias": jnp.asarray(b),
+        }
+    return params
+
+
+def load_torch_resnet50_weights(npz_path):
+    """torchvision resnet50 state-dict npz -> ResNet50Features params."""
+    flat = dict(np.load(npz_path))
+    params = {}
+
+    def put_conv(dst, src):
+        node = params
+        for p in dst[:-1]:
+            node = node.setdefault(p, {})
+        node[dst[-1]] = jnp.asarray(np.transpose(flat[src], (2, 3, 1, 0)))
+
+    def put_bn(dst, src):
+        node = params
+        for p in dst[:-1]:
+            node = node.setdefault(p, {})
+        node[dst[-1]] = {
+            "scale": jnp.asarray(flat[f"{src}.weight"]),
+            "bias": jnp.asarray(flat[f"{src}.bias"]),
+            "mean": jnp.asarray(flat[f"{src}.running_mean"]),
+            "var": jnp.asarray(flat[f"{src}.running_var"]),
+        }
+
+    put_conv(["conv1", "kernel"], "conv1.weight")
+    put_bn(["bn1"], "bn1")
+    for li, blocks in zip(range(1, 5), (3, 4, 6, 3)):
+        for bi in range(blocks):
+            base = f"layer{li}.{bi}"
+            dst = f"layer{li}_{bi}"
+            for ci in (1, 2, 3):
+                put_conv([dst, f"conv{ci}", "kernel"], f"{base}.conv{ci}.weight")
+                put_bn([dst, f"bn{ci}"], f"{base}.bn{ci}")
+            if f"{base}.downsample.0.weight" in flat:
+                put_conv([dst, "downsample_conv", "kernel"],
+                         f"{base}.downsample.0.weight")
+                put_bn([dst, "downsample_bn"], f"{base}.downsample.1")
+    return params
+
+
+def load_torch_vgg_face_weights(npz_path):
+    """vgg_face_dag npz (vgg16 features layout + classifier.0/3/6) ->
+    VGGFaceFeatures params."""
+    flat = dict(np.load(npz_path))
+    params = {}
+    conv_i = 0
+    torch_idx = 0
+    for v in _VGG16_CFG:
+        if v == "M":
+            torch_idx += 1
+            continue
+        w = flat[f"features.{torch_idx}.weight"]
+        params[f"conv_{conv_i}"] = {
+            "kernel": jnp.asarray(np.transpose(w, (2, 3, 1, 0))),
+            "bias": jnp.asarray(flat[f"features.{torch_idx}.bias"]),
+        }
+        conv_i += 1
+        torch_idx += 2  # conv + relu
+    for name, idx in (("fc6", 0), ("fc7", 3), ("fc8", 6)):
+        w = flat[f"classifier.{idx}.weight"]  # (out, in)
+        params[name] = {
+            "kernel": jnp.asarray(w.T),
+            "bias": jnp.asarray(flat[f"classifier.{idx}.bias"]),
         }
     return params

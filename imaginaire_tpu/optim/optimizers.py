@@ -95,13 +95,31 @@ def get_scheduler(cfg_opt, iters_per_epoch: int = 1) -> Callable[[int], float]:
     ptype = cfg_get(policy, "type", "constant")
     if ptype == "constant":
         return lambda step: 1.0
+    # iteration_mode counts optimizer steps directly; epoch mode converts
+    # via iters_per_epoch (ref: utils/trainer.py:219-258)
+    iteration_mode = cfg_get(policy, "iteration_mode", False)
     if ptype == "step":
         step_size = policy["step_size"]
         gamma = policy["gamma"]
 
         def sched(step):
-            epoch = step // max(iters_per_epoch, 1)
-            return gamma ** (epoch // step_size)
+            unit = step if iteration_mode else step // max(iters_per_epoch, 1)
+            return gamma ** (unit // step_size)
+
+        return sched
+    if ptype == "linear":
+        # constant until decay_start, then linear to 0 at decay_end
+        # (ref scheduler family)
+        decay_start = cfg_get(policy, "decay_start", 0)
+        decay_end = cfg_get(policy, "decay_end", decay_start + 1)
+
+        def sched(step):
+            # trace-safe: called with a traced step inside the jitted update
+            import jax.numpy as jnp
+
+            unit = step if iteration_mode else step // max(iters_per_epoch, 1)
+            frac = (unit - decay_start) / max(decay_end - decay_start, 1)
+            return jnp.clip(1.0 - frac, 0.0, 1.0)
 
         return sched
     raise NotImplementedError(f"Learning rate policy {ptype} not implemented.")

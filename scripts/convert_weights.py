@@ -68,6 +68,56 @@ def convert_inception(out_path):
     print(f"wrote {len(flat)} arrays to {out_path}")
 
 
+def convert_resnet50(out_path, robust_ckpt=None):
+    """torchvision resnet50 full state dict (raw names; the loader
+    imaginaire_tpu.losses.perceptual.load_torch_resnet50_weights does the
+    HWIO transpose). With ``robust_ckpt``, loads the adversarially
+    trained checkpoint (http://andrewilyas.com/ImageNet.pt) into the same
+    module first (ref: perceptual.py:275-297)."""
+    import torch
+    import torchvision
+
+    if robust_ckpt:
+        net = torchvision.models.resnet50(pretrained=False)
+        state = torch.load(robust_ckpt, map_location="cpu")["model"]
+        net.load_state_dict({k[13:]: v for k, v in state.items()
+                             if k.startswith("module.model.")})
+        net = net.eval()
+    else:
+        net = torchvision.models.resnet50(pretrained=True).eval()
+    flat = {k: v.detach().cpu().numpy() for k, v in net.state_dict().items()
+            if not k.startswith("fc.") and
+            not k.endswith("num_batches_tracked")}
+    np.savez(out_path, **flat)
+    print(f"wrote {len(flat)} arrays to {out_path}")
+
+
+def convert_vgg_face_dag(out_path, ckpt_path):
+    """vgg_face_dag checkpoint -> vgg16-features-style npz consumed by
+    load_torch_vgg_weights(path, 'vgg16') (ref: perceptual.py:300-325;
+    checkpoint from the reference's Google-Drive id)."""
+    import torch
+
+    state = torch.load(ckpt_path, map_location="cpu")
+    # vgg_face_dag names convs conv1_1..conv5_3; map onto torchvision
+    # vgg16.features indices (convs at 0,2,5,7,10,12,14,17,19,21,24,26,28)
+    conv_names = ["conv1_1", "conv1_2", "conv2_1", "conv2_2", "conv3_1",
+                  "conv3_2", "conv3_3", "conv4_1", "conv4_2", "conv4_3",
+                  "conv5_1", "conv5_2", "conv5_3"]
+    indices = [0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28]
+    flat = {}
+    for name, idx in zip(conv_names, indices):
+        flat[f"features.{idx}.weight"] = state[f"{name}.weight"].numpy()
+        flat[f"features.{idx}.bias"] = state[f"{name}.bias"].numpy()
+    # classifier head: the reference's only exposed taps are fc6/fc7/fc8
+    # (ref: perceptual.py:326-356)
+    for name, idx in (("fc6", 0), ("fc7", 3), ("fc8", 6)):
+        flat[f"classifier.{idx}.weight"] = state[f"{name}.weight"].numpy()
+        flat[f"classifier.{idx}.bias"] = state[f"{name}.bias"].numpy()
+    np.savez(out_path, **flat)
+    print(f"wrote {len(flat)} arrays to {out_path}")
+
+
 def _convtranspose(w):
     """torch ConvTranspose2d (in,out,kh,kw) -> flax ConvTranspose kernel
     (kh,kw,in,out) with spatial flip (verified numerically against
@@ -184,6 +234,14 @@ def main():
         convert_inception(out)
     elif name in ("vgg19", "vgg16", "alexnet"):
         convert_features(name, out)
+    elif name == "resnet50":
+        convert_resnet50(out)
+    elif name == "robust_resnet50":
+        convert_resnet50(out, robust_ckpt=sys.argv[3]
+                         if len(sys.argv) == 4 else "ImageNet.pt")
+    elif name == "vgg_face_dag":
+        convert_vgg_face_dag(out, sys.argv[3] if len(sys.argv) == 4
+                             else "vgg_face_dag.pth")
     elif name == "flownet2":
         convert_flownet2(sys.argv[3] if len(sys.argv) == 4 else
                          "flownet2.pth.tar", out)

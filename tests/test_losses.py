@@ -191,3 +191,78 @@ class TestFlowLoss:
                            np.asarray(data["image"])).mean()
         np.testing.assert_allclose(l_warp, want_warp, rtol=1e-5)
         assert np.isfinite(l_mask) and l_mask > 0
+
+
+class TestPerceptualBackbones:
+    def test_all_networks_compute(self, rng):
+        """Every reference perceptual backbone (perceptual.py:175-358) has
+        a port that initializes and yields a finite loss."""
+        import jax
+
+        from imaginaire_tpu.losses.perceptual import PerceptualLoss
+
+        cases = {
+            "vgg19": ["relu_1_1", "relu_4_1"],
+            "vgg16": ["relu_3_1"],
+            "vgg_face_dag": ["fc6", "relu_7"],
+            "alexnet": ["relu_3"],
+            "inception_v3": ["pool_2"],
+            "resnet50": ["layer_2"],
+            "robust_resnet50": ["layer_1"],
+        }
+        a = jnp.asarray(rng.rand(1, 96, 96, 3).astype(np.float32))
+        b = jnp.asarray(rng.rand(1, 96, 96, 3).astype(np.float32))
+        for net, layers in cases.items():
+            p = PerceptualLoss(network=net, layers=layers,
+                               allow_random_init=True)
+            params = p.init_params(jax.random.PRNGKey(0), image_hw=(96, 96))
+            loss = p(params, a, b)
+            assert np.isfinite(float(loss)), net
+
+    def test_resnet50_loader_roundtrip(self, rng, tmp_path):
+        """Synthesized torchvision-style state dict loads into the exact
+        param tree the Flax resnet expects."""
+        import jax
+
+        from imaginaire_tpu.losses.perceptual import (
+            ResNet50Features,
+            load_torch_resnet50_weights,
+        )
+
+        module = ResNet50Features(capture=("layer_1", "layer_4"))
+        ref = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+
+        flat = {}
+        flat["conv1.weight"] = rng.rand(64, 3, 7, 7).astype(np.float32)
+        for stat, init in (("weight", 1.0), ("bias", 0.0),
+                           ("running_mean", 0.0), ("running_var", 1.0)):
+            flat[f"bn1.{stat}"] = np.full((64,), init, np.float32)
+        for li, (blocks, feats) in enumerate([(3, 64), (4, 128), (6, 256),
+                                              (3, 512)], start=1):
+            for bi in range(blocks):
+                # tree-structure check only; in-channels are fabricated
+                for ci, (o, i_, k) in enumerate(
+                        [(feats, None, 1), (feats, feats, 3),
+                         (feats * 4, feats, 1)], start=1):
+                    w = rng.rand(o, 8, k, k).astype(np.float32)
+                    flat[f"layer{li}.{bi}.conv{ci}.weight"] = w
+                    for stat, init in (("weight", 1.0), ("bias", 0.0),
+                                       ("running_mean", 0.0),
+                                       ("running_var", 1.0)):
+                        flat[f"layer{li}.{bi}.bn{ci}.{stat}"] = np.full(
+                            (o,), init, np.float32)
+                if bi == 0:
+                    flat[f"layer{li}.{bi}.downsample.0.weight"] = rng.rand(
+                        feats * 4, 8, 1, 1).astype(np.float32)
+                    for stat, init in (("weight", 1.0), ("bias", 0.0),
+                                       ("running_mean", 0.0),
+                                       ("running_var", 1.0)):
+                        flat[f"layer{li}.{bi}.downsample.1.{stat}"] = np.full(
+                            (feats * 4,), init, np.float32)
+        path = tmp_path / "resnet50.npz"
+        np.savez(path, **flat)
+        loaded = load_torch_resnet50_weights(str(path))
+        # same tree structure (module names + leaf names)
+        ref_keys = jax.tree_util.tree_structure(ref["params"])
+        loaded_keys = jax.tree_util.tree_structure(loaded)
+        assert ref_keys == loaded_keys

@@ -45,6 +45,20 @@ def main():
     trainer = trainer_cls(cfg, train_data_loader=train_loader,
                           val_data_loader=val_loader)
 
+    # hparams dashboard entry (ref: train.py + meters.add_hparams)
+    from imaginaire_tpu.utils.meters import add_hparams
+
+    add_hparams({
+        "trainer": str(cfg.trainer.type),
+        "gen": str(cfg.gen.type),
+        "gen_lr": float(cfg_get(cfg.gen_opt, "lr", 0)),
+        "dis_lr": float(cfg_get(cfg.dis_opt, "lr", 0)),
+        "batch_size": int(cfg_get(cfg.data.train, "batch_size", 1)),
+        "compute_dtype": str(cfg_get(cfg.trainer, "compute_dtype",
+                                     "float32")),
+        "seed": args.seed,
+    }, {"metrics/placeholder": 0.0})
+
     sample = next(iter(train_loader))
     sample = trainer.start_of_iteration(sample, 0)
     trainer.init_state(jax.random.PRNGKey(args.seed), sample)
