@@ -94,22 +94,55 @@ class LMDBBackend:
 
 class PackedBackend:
     """Packed binary shard: ``data.bin`` + ``index.json`` ({key: [off, len,
-    ext]}). Reads are a single seek+read — the property LMDB provided."""
+    ext]}). Reads are a single positioned read — the property LMDB
+    provided — served by the native C++ thread-pool reader when the
+    toolchain is available (imaginaire_tpu/native), else Python IO."""
 
     def __init__(self, root, ext=None):
         with open(os.path.join(root, "index.json")) as f:
             self.index = json.load(f)
         self.bin_path = os.path.join(root, "data.bin")
         self._f = None
+        self._native = None
+        self._native_tried = False
         self.ext = ext
 
+    def _reader(self):
+        if not self._native_tried:
+            self._native_tried = True
+            try:
+                from imaginaire_tpu.native import NativeBlobReader
+
+                self._native = NativeBlobReader(self.bin_path)
+            except Exception:
+                self._native = None
+        return self._native
+
     def getitem(self, key):
-        if self._f is None:  # lazy per-worker open
-            self._f = open(self.bin_path, "rb")
         off, length, ext = self.index[key]
-        self._f.seek(off)
-        buf = self._f.read(length)
+        native = self._reader()
+        if native is not None:
+            buf = native.read(off, length)
+        else:
+            # os.pread is atomic per call — safe under the prefetch
+            # thread pool (a shared seek+read handle is not)
+            if self._f is None:  # lazy per-worker open
+                self._f = os.open(self.bin_path, os.O_RDONLY)
+            buf = os.pread(self._f, length, off)
         return _decode_image(buf, ext or self.ext)
+
+    def getitems(self, keys):
+        """Batch fetch: one concurrent native read per extent."""
+        native = self._reader()
+        entries = [self.index[k] for k in keys]
+        if native is not None:
+            bufs = native.read_batch([(off, length)
+                                      for off, length, _ in entries])
+        else:
+            bufs = [self.getitem(k) for k in keys]
+            return bufs
+        return [_decode_image(buf, ext or self.ext)
+                for buf, (_, _, ext) in zip(bufs, entries)]
 
 
 def build_packed_dataset(data_root, out_root, data_types):

@@ -124,14 +124,19 @@ class BaseDataset:
     # ------------------------------------------------------------- loading
 
     def load_item(self, lmdb_idx, sequence_name, filenames):
-        """Load all data types for the given frames -> {type: [HWC arrays]}."""
+        """Load all data types for the given frames -> {type: [HWC arrays]}.
+
+        Backends exposing ``getitems`` (the packed shard's native
+        thread-pool reader) fetch a whole frame window in one concurrent
+        batched read — the hot path for video datasets."""
         data = {}
         for t in self.data_types:
-            frames = []
-            for fname in filenames:
-                key = f"{sequence_name}/{fname}"
-                frames.append(self.backends[t][lmdb_idx].getitem(key))
-            data[t] = frames
+            backend = self.backends[t][lmdb_idx]
+            keys = [f"{sequence_name}/{fname}" for fname in filenames]
+            if len(keys) > 1 and hasattr(backend, "getitems"):
+                data[t] = backend.getitems(keys)
+            else:
+                data[t] = [backend.getitem(k) for k in keys]
         return data
 
     def process_item(self, data):

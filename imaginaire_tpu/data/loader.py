@@ -19,13 +19,15 @@ from imaginaire_tpu.registry import resolve
 
 class DataLoader:
     def __init__(self, dataset, batch_size, shuffle=True, seed=0,
-                 drop_last=True):
+                 drop_last=True, num_workers=0, prefetch_batches=2):
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.seed = seed
         self.epoch = 0
         self.drop_last = drop_last
+        self.num_workers = num_workers
+        self.prefetch_batches = max(prefetch_batches, 1)
 
     def set_epoch(self, epoch):
         self.epoch = epoch
@@ -36,21 +38,91 @@ class DataLoader:
             return max(n // self.batch_size, 1)
         return (n + self.batch_size - 1) // self.batch_size
 
-    def __iter__(self):
-        n = len(self.dataset)
-        order = np.arange(n)
+    def _order(self):
+        order = np.arange(len(self.dataset))
         if self.shuffle:
             rng = np.random.RandomState(self.seed + self.epoch)
             rng.shuffle(order)
-        order = order[get_rank()::get_world_size()]
+        return order[get_rank()::get_world_size()]
+
+    def __iter__(self):
+        if self.num_workers > 0:
+            yield from self._iter_prefetch()
+            return
         batch = []
-        for idx in order:
+        for idx in self._order():
             batch.append(self.dataset[int(idx)])
             if len(batch) == self.batch_size:
                 yield self._collate(batch)
                 batch = []
         if batch and not self.drop_last:
             yield self._collate(batch)
+
+    def _iter_prefetch(self):
+        """Worker-threaded pipeline (the num_workers contract of the
+        reference's DataLoader, ref: utils/dataset.py:56-61): samples
+        load+decode in a thread pool (cv2/numpy release the GIL; packed
+        shards read through the native C++ pool) while the trainer
+        consumes the previous batch; a bounded queue caps read-ahead.
+
+        Lifecycle: worker exceptions travel through the queue and re-raise
+        in the consumer; abandoning the iterator early (next(iter(...)),
+        break, GeneratorExit) sets a stop flag and drains the queue so the
+        producer's blocked put always unwinds — no deadlock either way."""
+        import queue
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        order = self._order()
+        batches = [order[i:i + self.batch_size]
+                   for i in range(0, len(order), self.batch_size)]
+        if self.drop_last and batches and \
+                len(batches[-1]) < self.batch_size:
+            batches.pop()
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_batches)
+        stop = threading.Event()
+        sentinel = object()
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+        def produce():
+            try:
+                with ThreadPoolExecutor(self.num_workers) as pool:
+                    for idxs in batches:
+                        if stop.is_set():
+                            return
+                        futures = [pool.submit(self.dataset.__getitem__,
+                                               int(i)) for i in idxs]
+                        put(self._collate([f.result() for f in futures]))
+            except BaseException as e:  # forwarded to the consumer
+                put(e)
+            finally:
+                put(sentinel)
+
+        producer = threading.Thread(target=produce, daemon=True)
+        producer.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            producer.join(timeout=10)
 
     @staticmethod
     def _collate(items):
@@ -75,10 +147,11 @@ def get_train_and_val_dataloader(cfg, seed=0):
     """(ref: utils/dataset.py:63-83)."""
     train_ds = _build_dataset(cfg, is_inference=False)
     val_ds = _build_dataset(cfg, is_inference=True)
+    num_workers = cfg_get(cfg.data, "num_workers", 0)
     train = DataLoader(train_ds, cfg_get(cfg.data.train, "batch_size", 1),
-                       shuffle=True, seed=seed)
+                       shuffle=True, seed=seed, num_workers=num_workers)
     val = DataLoader(val_ds, cfg_get(cfg.data.val, "batch_size", 1),
-                     shuffle=False, seed=seed)
+                     shuffle=False, seed=seed, num_workers=num_workers)
     return train, val
 
 

@@ -1,0 +1,150 @@
+// Native IO runtime for the packed-shard data path
+// (the TPU-native counterpart of the reference's C++ DataLoader workers
+// and LMDB readers — large sequential reads feeding TPU-VM hosts).
+//
+// Exposes a C ABI consumed via ctypes (no pybind11 in this image):
+//   - br_open/br_close: file handles
+//   - br_read: positioned read into a caller buffer
+//   - br_prefetch_submit/br_prefetch_wait: a thread pool reads a batch of
+//     (offset, length) extents concurrently into one contiguous arena,
+//     overlapping disk latency with host-side decode of the previous batch.
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Task {
+  int fd;
+  uint64_t offset;
+  uint64_t length;
+  uint8_t* dst;
+  int64_t* bytes_read;  // per-extent status for the caller
+};
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(int n_threads) : stop_(false), pending_(0) {
+    for (int i = 0; i < n_threads; ++i) {
+      workers_.emplace_back([this] { Run(); });
+    }
+  }
+
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+  void Submit(Task t) {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      tasks_.push(t);
+      ++pending_;
+    }
+    cv_.notify_one();
+  }
+
+  void Wait() {
+    std::unique_lock<std::mutex> lock(mu_);
+    done_cv_.wait(lock, [this] { return pending_ == 0; });
+  }
+
+ private:
+  void Run() {
+    for (;;) {
+      Task t;
+      {
+        std::unique_lock<std::mutex> lock(mu_);
+        cv_.wait(lock, [this] { return stop_ || !tasks_.empty(); });
+        if (stop_ && tasks_.empty()) return;
+        t = tasks_.front();
+        tasks_.pop();
+      }
+      uint64_t done = 0;
+      while (done < t.length) {
+        ssize_t n = pread(t.fd, t.dst + done, t.length - done,
+                          static_cast<off_t>(t.offset + done));
+        if (n <= 0) break;
+        done += static_cast<uint64_t>(n);
+      }
+      if (t.bytes_read != nullptr) {
+        *t.bytes_read = static_cast<int64_t>(done);
+      }
+      {
+        std::lock_guard<std::mutex> lock(mu_);
+        if (--pending_ == 0) done_cv_.notify_all();
+      }
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  std::queue<Task> tasks_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::condition_variable done_cv_;
+  bool stop_;
+  int pending_;
+};
+
+ThreadPool* pool = nullptr;
+std::mutex pool_mu;
+
+ThreadPool* GetPool(int n_threads) {
+  std::lock_guard<std::mutex> lock(pool_mu);
+  if (pool == nullptr) pool = new ThreadPool(n_threads > 0 ? n_threads : 4);
+  return pool;
+}
+
+}  // namespace
+
+extern "C" {
+
+int br_open(const char* path) { return open(path, O_RDONLY); }
+
+void br_close(int fd) {
+  if (fd >= 0) close(fd);
+}
+
+// Positioned read; returns bytes read or -1.
+int64_t br_read(int fd, uint64_t offset, uint64_t length, uint8_t* dst) {
+  uint64_t done = 0;
+  while (done < length) {
+    ssize_t n = pread(fd, dst + done, length - done,
+                      static_cast<off_t>(offset + done));
+    if (n < 0) return -1;
+    if (n == 0) break;
+    done += static_cast<uint64_t>(n);
+  }
+  return static_cast<int64_t>(done);
+}
+
+// Read `count` extents concurrently into `arena`, which is laid out as the
+// concatenation of the extents (caller computes dst offsets = prefix sums).
+// bytes_read (len `count`, caller-allocated) receives per-extent byte
+// counts so short reads surface instead of silently zero-filling.
+void br_read_batch(int fd, const uint64_t* offsets, const uint64_t* lengths,
+                   int count, uint8_t* arena, int64_t* bytes_read,
+                   int n_threads) {
+  ThreadPool* p = GetPool(n_threads);
+  uint64_t dst_off = 0;
+  for (int i = 0; i < count; ++i) {
+    p->Submit(Task{fd, offsets[i], lengths[i], arena + dst_off,
+                   bytes_read == nullptr ? nullptr : bytes_read + i});
+    dst_off += lengths[i];
+  }
+  p->Wait();
+}
+
+}  // extern "C"
