@@ -99,24 +99,50 @@ class PackedBackend:
     toolchain is available (imaginaire_tpu/native), else Python IO."""
 
     def __init__(self, root, ext=None):
+        import threading
+
         with open(os.path.join(root, "index.json")) as f:
             self.index = json.load(f)
         self.bin_path = os.path.join(root, "data.bin")
         self._f = None
         self._native = None
         self._native_tried = False
+        self._lock = threading.Lock()  # prefetch workers share the backend
         self.ext = ext
 
     def _reader(self):
-        if not self._native_tried:
-            self._native_tried = True
-            try:
-                from imaginaire_tpu.native import NativeBlobReader
+        with self._lock:
+            if not self._native_tried:
+                self._native_tried = True
+                try:
+                    from imaginaire_tpu.native import NativeBlobReader
 
-                self._native = NativeBlobReader(self.bin_path)
-            except Exception:
-                self._native = None
+                    self._native = NativeBlobReader(self.bin_path)
+                except Exception:
+                    self._native = None
         return self._native
+
+    def _fd(self):
+        with self._lock:
+            if self._f is None:
+                self._f = os.open(self.bin_path, os.O_RDONLY)
+        return self._f
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                os.close(self._f)
+                self._f = None
+            if self._native is not None:
+                self._native.close()
+                self._native = None
+                self._native_tried = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def getitem(self, key):
         off, length, ext = self.index[key]
@@ -126,9 +152,7 @@ class PackedBackend:
         else:
             # os.pread is atomic per call — safe under the prefetch
             # thread pool (a shared seek+read handle is not)
-            if self._f is None:  # lazy per-worker open
-                self._f = os.open(self.bin_path, os.O_RDONLY)
-            buf = os.pread(self._f, length, off)
+            buf = os.pread(self._fd(), length, off)
         return _decode_image(buf, ext or self.ext)
 
     def getitems(self, keys):

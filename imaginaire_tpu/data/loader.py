@@ -148,10 +148,13 @@ def get_train_and_val_dataloader(cfg, seed=0):
     train_ds = _build_dataset(cfg, is_inference=False)
     val_ds = _build_dataset(cfg, is_inference=True)
     num_workers = cfg_get(cfg.data, "num_workers", 0)
+    prefetch = cfg_get(cfg.data, "prefetch", 2)
     train = DataLoader(train_ds, cfg_get(cfg.data.train, "batch_size", 1),
-                       shuffle=True, seed=seed, num_workers=num_workers)
+                       shuffle=True, seed=seed, num_workers=num_workers,
+                       prefetch_batches=prefetch)
     val = DataLoader(val_ds, cfg_get(cfg.data.val, "batch_size", 1),
-                     shuffle=False, seed=seed, num_workers=num_workers)
+                     shuffle=False, seed=seed, num_workers=num_workers,
+                     prefetch_batches=prefetch)
     return train, val
 
 

@@ -14,9 +14,21 @@ from imaginaire_tpu.layers.conv import Conv2dBlock
 
 
 class NonLocal2dBlock(nn.Module):
+    """Self-attention block (ref: layers/non_local.py).
+
+    ``ring_axis``: run the attention as ring attention over that mesh
+    axis (sequence/context parallelism, parallel/ring_attention.py) —
+    for feature maps whose token count exceeds one device, when the
+    block executes inside a shard_map with H sharded over the axis.
+    The pooled-key memory optimization is skipped in ring mode (the
+    ring already bounds per-device memory). Initialize with the
+    ring_axis='' twin (identical param tree) — collectives are unbound
+    outside shard_map."""
+
     scale: bool = True
     clamp: bool = False
     weight_norm_type: str = "spectral"
+    ring_axis: str = ""
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -31,13 +43,24 @@ class NonLocal2dBlock(nn.Module):
             order="C",
             name=name,
         )
-        theta = conv(ch, "theta")(x, training=training).reshape(b, h * w, ch)
-        phi = conv(ch, "phi")(x, training=training)
-        phi = nn.max_pool(phi, (2, 2), strides=(2, 2)).reshape(b, -1, ch)
-        g = conv(cg, "g")(x, training=training)
-        g = nn.max_pool(g, (2, 2), strides=(2, 2)).reshape(b, -1, cg)
-        attn = nn.softmax(jnp.einsum("bnc,bmc->bnm", theta, phi), axis=-1)
-        y = jnp.einsum("bnm,bmc->bnc", attn, g).reshape(b, h, w, cg)
+        if self.ring_axis:
+            from imaginaire_tpu.parallel.ring_attention import ring_attention
+
+            q = conv(ch, "theta")(x, training=training).reshape(
+                b, h * w, 1, ch)
+            k = conv(ch, "phi")(x, training=training).reshape(b, h * w, 1, ch)
+            v = conv(cg, "g")(x, training=training).reshape(b, h * w, 1, cg)
+            y = ring_attention(q, k, v, self.ring_axis, scale=1.0)
+            y = y.reshape(b, h, w, cg)
+        else:
+            theta = conv(ch, "theta")(x, training=training).reshape(
+                b, h * w, ch)
+            phi = conv(ch, "phi")(x, training=training)
+            phi = nn.max_pool(phi, (2, 2), strides=(2, 2)).reshape(b, -1, ch)
+            g = conv(cg, "g")(x, training=training)
+            g = nn.max_pool(g, (2, 2), strides=(2, 2)).reshape(b, -1, cg)
+            attn = nn.softmax(jnp.einsum("bnc,bmc->bnm", theta, phi), axis=-1)
+            y = jnp.einsum("bnm,bmc->bnc", attn, g).reshape(b, h, w, cg)
         y = conv(c, "out")(y, training=training)
         gamma = self.param("gamma", nn.initializers.zeros, ())
         return x + gamma * y

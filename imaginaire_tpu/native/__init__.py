@@ -26,9 +26,13 @@ _build_failed = False
 
 def _build():
     os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    # build to a temp path and rename atomically: an interrupted or
+    # concurrent build must never leave a corrupt .so at the final path
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", _SO]
+           _SRC, "-o", tmp]
     subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, _SO)
 
 
 def load_library():
@@ -66,6 +70,9 @@ class NativeBlobReader:
     """Concurrent positioned reads over one packed data.bin."""
 
     def __init__(self, path, n_threads=4):
+        """n_threads sizes the process-wide pool on its FIRST use; later
+        readers share that pool (per-call completion keeps concurrent
+        batches independent)."""
         self._lib = load_library()
         if self._lib is None:
             raise RuntimeError("native blob reader unavailable")

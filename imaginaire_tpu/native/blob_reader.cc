@@ -22,17 +22,26 @@
 
 namespace {
 
+// Per-br_read_batch completion tracker so concurrent callers never
+// barrier on each other's extents.
+struct BatchState {
+  std::mutex mu;
+  std::condition_variable cv;
+  int remaining;
+};
+
 struct Task {
   int fd;
   uint64_t offset;
   uint64_t length;
   uint8_t* dst;
   int64_t* bytes_read;  // per-extent status for the caller
+  BatchState* batch;
 };
 
 class ThreadPool {
  public:
-  explicit ThreadPool(int n_threads) : stop_(false), pending_(0) {
+  explicit ThreadPool(int n_threads) : stop_(false) {
     for (int i = 0; i < n_threads; ++i) {
       workers_.emplace_back([this] { Run(); });
     }
@@ -51,14 +60,8 @@ class ThreadPool {
     {
       std::lock_guard<std::mutex> lock(mu_);
       tasks_.push(t);
-      ++pending_;
     }
     cv_.notify_one();
-  }
-
-  void Wait() {
-    std::unique_lock<std::mutex> lock(mu_);
-    done_cv_.wait(lock, [this] { return pending_ == 0; });
   }
 
  private:
@@ -83,8 +86,8 @@ class ThreadPool {
         *t.bytes_read = static_cast<int64_t>(done);
       }
       {
-        std::lock_guard<std::mutex> lock(mu_);
-        if (--pending_ == 0) done_cv_.notify_all();
+        std::lock_guard<std::mutex> lock(t.batch->mu);
+        if (--t.batch->remaining == 0) t.batch->cv.notify_all();
       }
     }
   }
@@ -93,9 +96,7 @@ class ThreadPool {
   std::queue<Task> tasks_;
   std::mutex mu_;
   std::condition_variable cv_;
-  std::condition_variable done_cv_;
   bool stop_;
-  int pending_;
 };
 
 ThreadPool* pool = nullptr;
@@ -137,14 +138,18 @@ int64_t br_read(int fd, uint64_t offset, uint64_t length, uint8_t* dst) {
 void br_read_batch(int fd, const uint64_t* offsets, const uint64_t* lengths,
                    int count, uint8_t* arena, int64_t* bytes_read,
                    int n_threads) {
+  if (count <= 0) return;
   ThreadPool* p = GetPool(n_threads);
+  BatchState batch;
+  batch.remaining = count;
   uint64_t dst_off = 0;
   for (int i = 0; i < count; ++i) {
     p->Submit(Task{fd, offsets[i], lengths[i], arena + dst_off,
-                   bytes_read == nullptr ? nullptr : bytes_read + i});
+                   bytes_read == nullptr ? nullptr : bytes_read + i, &batch});
     dst_off += lengths[i];
   }
-  p->Wait();
+  std::unique_lock<std::mutex> lock(batch.mu);
+  batch.cv.wait(lock, [&batch] { return batch.remaining == 0; });
 }
 
 }  // extern "C"

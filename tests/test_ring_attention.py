@@ -1,0 +1,109 @@
+"""Ring attention: exactness vs full attention on a virtual 8-device
+mesh, and gradient flow through the ring."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from imaginaire_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_self_attention_2d,
+)
+
+
+def full_attention(q, k, v, scale=None):
+    scale = scale or q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = np.array(jax.devices()[:8])
+    if devices.size < 8:
+        pytest.skip("needs 8 virtual devices")
+    return Mesh(devices, ("seq",))
+
+
+class TestRingAttention:
+    def test_matches_full_attention(self, mesh, rng):
+        b, n, h, d = 2, 64, 4, 16  # 8 tokens per device
+        q = jnp.asarray(rng.randn(b, n, h, d).astype(np.float32))
+        k = jnp.asarray(rng.randn(b, n, h, d).astype(np.float32))
+        v = jnp.asarray(rng.randn(b, n, h, d).astype(np.float32))
+
+        from jax import shard_map
+
+        ring = shard_map(
+            lambda q_, k_, v_: ring_attention(q_, k_, v_, "seq"),
+            mesh=mesh,
+            in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+            out_specs=P(None, "seq"))
+        got = jax.jit(ring)(q, k, v)
+        want = full_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_gradients_flow_around_ring(self, mesh, rng):
+        """d(output on device 0)/d(values on other devices) must be
+        nonzero — the ring really attends across shards."""
+        b, n, h, d = 1, 32, 2, 8
+        q = jnp.asarray(rng.randn(b, n, h, d).astype(np.float32))
+        k = jnp.asarray(rng.randn(b, n, h, d).astype(np.float32))
+        v = jnp.asarray(rng.randn(b, n, h, d).astype(np.float32))
+
+        from jax import shard_map
+
+        ring = shard_map(
+            lambda q_, k_, v_: ring_attention(q_, k_, v_, "seq"),
+            mesh=mesh,
+            in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+            out_specs=P(None, "seq"))
+
+        def first_block_sum(v_):
+            return jnp.sum(ring(q, k, v_)[:, :4])
+
+        g = jax.jit(jax.grad(first_block_sum))(v)
+        # values living on the LAST shard still influence the first block
+        assert float(jnp.abs(g[:, -4:]).sum()) > 0
+
+        want = jax.grad(
+            lambda v_: jnp.sum(full_attention(q, k, v_)[:, :4]))(v)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_spatial_wrapper(self, mesh, rng):
+        b, h, w, c = 1, 16, 8, 32  # rows sharded: 2 rows per device
+        x = jnp.asarray(rng.randn(b, h, w, c).astype(np.float32))
+
+        from jax import shard_map
+
+        ring = shard_map(
+            lambda x_: ring_self_attention_2d(x_, "seq", num_heads=4),
+            mesh=mesh, in_specs=(P(None, "seq"),), out_specs=P(None, "seq"))
+        got = jax.jit(ring)(x)
+        tokens = x.reshape(b, h * w, 4, c // 4)
+        want = full_attention(tokens, tokens, tokens).reshape(b, h, w, c)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_non_local_block_ring_mode(self, mesh, rng):
+        """NonLocal2dBlock(ring_axis=...) runs under shard_map with rows
+        sharded, using params initialized by the ring-free twin."""
+        from jax import shard_map
+
+        from imaginaire_tpu.layers.non_local import NonLocal2dBlock
+
+        x = jnp.asarray(rng.randn(1, 16, 8, 16).astype(np.float32))
+        variables = NonLocal2dBlock().init(jax.random.PRNGKey(0), x)
+        blk = NonLocal2dBlock(ring_axis="seq")
+        with mesh:
+            f = shard_map(lambda xx: blk.apply(variables, xx), mesh=mesh,
+                          in_specs=(P(None, "seq"),),
+                          out_specs=P(None, "seq"))
+            out = jax.jit(f)(x)
+        assert out.shape == x.shape
+        assert np.all(np.isfinite(np.asarray(out)))
