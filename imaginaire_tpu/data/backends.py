@@ -129,6 +129,11 @@ class PackedBackend:
         return self._f
 
     def close(self):
+        """Release the fd/native reader. Call only after all reads have
+        quiesced (an in-flight pread on the closed fd could hit a
+        recycled descriptor); a closed backend stays closed — getitem
+        after close reopens the plain fd but never resurrects the
+        native reader."""
         with self._lock:
             if self._f is not None:
                 os.close(self._f)
@@ -136,7 +141,6 @@ class PackedBackend:
             if self._native is not None:
                 self._native.close()
                 self._native = None
-                self._native_tried = False
 
     def __del__(self):
         try:

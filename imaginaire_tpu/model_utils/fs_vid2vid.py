@@ -215,6 +215,30 @@ def crop_face_from_data(cfg, is_inference, data):
     return data
 
 
+def pre_process_densepose(pose_cfg, pose_map, is_infer=False, rng=None):
+    """Pre-process the DensePose channels of a pose label map
+    (ref: fs_vid2vid.py:780-811). pose_map: (..., H, W, C) float in
+    [0, 1] with the part-index map in channel 2 scaled to [0, 1] over 24
+    parts. Training randomly zeroes body parts; output is renormalized
+    to [-1, 1] (host-side numpy — this is a data-pipeline op)."""
+    import random as _random
+
+    from imaginaire_tpu.config import cfg_get
+
+    pose_map = np.array(pose_map, np.float32, copy=True)
+    part_map = pose_map[..., 2] * 255.0  # [0, 24]
+    random_drop_prob = 0 if is_infer else cfg_get(pose_cfg,
+                                                  "random_drop_prob", 0)
+    rng = rng or _random
+    if random_drop_prob > 0:
+        for part_id in range(1, 25):
+            if rng.random() < random_drop_prob:
+                mask = np.abs(part_map - part_id) < 0.1
+                pose_map[..., :3][mask] = 0.0
+    pose_map[..., 2] = pose_map[..., 2] * (255.0 / 24.0)
+    return pose_map * 2.0 - 1.0
+
+
 def extract_valid_pose_labels(pose_map, pose_type, remove_face_labels,
                               do_remove=True):
     """Slice pose label channels by pose_type

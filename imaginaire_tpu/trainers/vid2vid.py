@@ -110,6 +110,31 @@ class Trainer(BaseTrainer):
             params["flownet"] = self.flow_net_wrapper.params
         return params
 
+    # ---------------------------------------------------------- data hooks
+
+    def _start_of_iteration(self, data, current_iteration):
+        """DensePose preprocessing for pose datasets
+        (ref: trainers/vid2vid.py:206-233 pre_process)."""
+        pose_cfg = cfg_get(self.cfg.data, "for_pose_dataset", None)
+        if pose_cfg is not None and \
+                "pose_maps-densepose" in (cfg_get(self.cfg.data,
+                                                  "input_labels", []) or []):
+            from imaginaire_tpu.model_utils.fs_vid2vid import (
+                pre_process_densepose,
+            )
+
+            data = dict(data)
+            data["label"] = pre_process_densepose(
+                pose_cfg, np.asarray(data["label"]),
+                is_infer=current_iteration < 0)
+            if "ref_labels" in data:
+                # few-shot reference labels share the scale; never drop
+                # parts from them (ref preprocesses few_shot_label with
+                # is_infer=True)
+                data["ref_labels"] = pre_process_densepose(
+                    pose_cfg, np.asarray(data["ref_labels"]), is_infer=True)
+        return data
+
     # --------------------------------------------------------------- state
 
     def _frame0(self, data):

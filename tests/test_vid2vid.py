@@ -156,3 +156,33 @@ class TestVid2VidTraining:
         trainer._start_of_epoch(2)  # temporal init
         assert trainer.sequence_length == 3  # initial (3) clamped to max
         assert FakeLoader.dataset.seq == 3
+
+
+class TestDensePosePreprocessing:
+    def test_pre_process_densepose(self):
+        from imaginaire_tpu.config import AttrDict
+        from imaginaire_tpu.model_utils.fs_vid2vid import pre_process_densepose
+
+        rng = np.random.RandomState(0)
+        pose = rng.rand(1, 8, 8, 6).astype(np.float32)
+        pose[..., 2] = rng.randint(0, 25, (1, 8, 8)) / 255.0  # part ids
+        cfg = AttrDict({"random_drop_prob": 0.0})
+        out = pre_process_densepose(cfg, pose)
+        assert out.min() >= -1.0 and out.max() <= 1.0
+        # part channel rescaled 24 -> 255 range before normalization
+        np.testing.assert_allclose(
+            out[..., 2], (pose[..., 2] * 255 / 24) * 2 - 1, rtol=1e-5)
+
+    def test_random_drop_zeroes_parts(self):
+        import random
+
+        from imaginaire_tpu.config import AttrDict
+        from imaginaire_tpu.model_utils.fs_vid2vid import pre_process_densepose
+
+        pose = np.ones((1, 4, 4, 3), np.float32) * 0.5
+        pose[..., 2] = 5 / 255.0  # every pixel is part 5
+        cfg = AttrDict({"random_drop_prob": 1.0})
+        out = pre_process_densepose(cfg, pose, rng=random.Random(0))
+        # part 5 dropped everywhere -> densepose channels at -1 (zero
+        # before renormalization)
+        np.testing.assert_allclose(out[..., :3], -1.0)
