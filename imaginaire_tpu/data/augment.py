@@ -123,7 +123,15 @@ class Augmentor:
         return out, is_flipped
 
     def _apply_keypoints(self, pts, ops):
-        """Co-transform (N, 2[+extra]) xy coordinates with the image ops."""
+        """Co-transform (N, 2[+extra]) xy coordinates with the image ops.
+
+        OpenPose frames arrive as dicts of keypoint groups
+        ({pose, face, hand_l, hand_r}, see visualization.pose
+        openpose_to_npy) — each group is co-transformed."""
+        if isinstance(pts, dict):
+            return {k: self._apply_keypoints(v, ops) for k, v in pts.items()}
+        if pts is None:
+            return None
         pts = np.asarray(pts, np.float32).copy()
         if pts.ndim != 2 or pts.shape[-1] < 2:
             return pts

@@ -35,6 +35,10 @@ def _decode_image(buf, ext):
         return buf.decode("utf-8")
     if ext in ("pkl", "pickle"):
         return buf
+    if ext in ("mp4", "avi", "mov", "webm", "mkv"):
+        # raw encoded video blob; decoded by the video datasets
+        # (paired_few_shot_videos_native) via cv2.VideoCapture
+        return buf
     arr = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), cv2.IMREAD_UNCHANGED)
     if arr is None:
         raise ValueError("failed to decode image buffer")

@@ -151,10 +151,27 @@ class BaseDataset:
         data = self._apply_ops(data, self.pre_aug_ops)
         data, is_flipped = self.augmentor.perform_augmentation(
             data, paired=True)
+        # Keep the co-transformed keypoint coordinates as '<type>_xy'
+        # before the vis:: op rasterizes them into label maps
+        # (ref: paired_few_shot_videos.py:241-246); full-data ops like
+        # crop_face_from_data consume these.
+        kp_copies = {}
+        for t in self.keypoint_data_types:
+            frames = data.get(t)
+            if frames and not isinstance(frames[0], dict):
+                try:
+                    kp_copies[t + "_xy"] = np.stack(
+                        [np.asarray(f, np.float32) for f in frames])
+                except ValueError:
+                    pass  # ragged per-frame keypoint counts: skip the stash
         data = self._apply_ops(data, self.post_aug_ops)
+        data.update(kp_copies)
         data = self._apply_full_data_ops(data)
 
         out = {}
+        for k in kp_copies:
+            if k in data:
+                out[k] = data[k]
         for t in self.data_types:
             frames = []
             for arr in data[t]:

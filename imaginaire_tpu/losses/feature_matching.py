@@ -11,9 +11,16 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from imaginaire_tpu.losses.gan import _weighted_mean
 
-def feature_matching_loss(fake_features, real_features, criterion="l1"):
-    """fake_features / real_features: list (per D) of lists (per layer)."""
+
+def feature_matching_loss(fake_features, real_features, criterion="l1",
+                          sample_weight=None):
+    """fake_features / real_features: list (per D) of lists (per layer).
+
+    ``sample_weight``: optional (B,) validity weights — region
+    discriminators weight out samples whose region was absent instead of
+    skipping them (static shapes under jit)."""
     num_d = len(fake_features)
     dis_weight = 1.0 / num_d
     loss = jnp.zeros(())
@@ -21,10 +28,10 @@ def feature_matching_loss(fake_features, real_features, criterion="l1"):
         for fake_f, real_f in zip(fake_per_d, real_per_d):
             real_f = jax.lax.stop_gradient(real_f)
             if criterion == "l1":
-                term = jnp.mean(jnp.abs(fake_f - real_f))
+                diff = jnp.abs(fake_f - real_f)
             elif criterion in ("l2", "mse"):
-                term = jnp.mean((fake_f - real_f) ** 2)
+                diff = (fake_f - real_f) ** 2
             else:
                 raise ValueError(f"Criterion {criterion} is not recognized")
-            loss = loss + dis_weight * term
+            loss = loss + dis_weight * _weighted_mean(diff, sample_weight)
     return loss

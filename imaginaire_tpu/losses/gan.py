@@ -17,30 +17,46 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def _single_gan_loss(logits, t_real, mode, dis_update, real_label, fake_label):
+def _weighted_mean(loss, sample_weight):
+    """Mean over all elements, or a per-sample weighted mean when
+    ``sample_weight`` (B,) is given — the static-shape replacement for
+    the reference's skip-absent-regions control flow."""
+    if sample_weight is None:
+        return jnp.mean(loss)
+    per_sample = jnp.mean(loss.reshape(loss.shape[0], -1), axis=-1)
+    denom = jnp.maximum(jnp.sum(sample_weight), 1e-6)
+    return jnp.sum(per_sample * sample_weight) / denom
+
+
+def _single_gan_loss(logits, t_real, mode, dis_update, real_label, fake_label,
+                     sample_weight=None):
     if not dis_update and not t_real:
         raise ValueError("The target should be real when updating the generator.")
     if mode == "non_saturated":
         target = jnp.full_like(logits, real_label if t_real else fake_label)
         # BCE-with-logits, mean reduction (ref: gan.py:92-95).
         loss = jnp.maximum(logits, 0) - logits * target + jnp.log1p(jnp.exp(-jnp.abs(logits)))
-        return jnp.mean(loss)
+        return _weighted_mean(loss, sample_weight)
     if mode == "least_square":
         target = jnp.full_like(logits, real_label if t_real else fake_label)
-        return 0.5 * jnp.mean((logits - target) ** 2)
+        return 0.5 * _weighted_mean((logits - target) ** 2, sample_weight)
     if mode == "hinge":
         if dis_update:
             if t_real:
-                return -jnp.mean(jnp.minimum(logits - 1.0, 0.0))
-            return -jnp.mean(jnp.minimum(-logits - 1.0, 0.0))
-        return -jnp.mean(logits)
+                return -_weighted_mean(jnp.minimum(logits - 1.0, 0.0),
+                                       sample_weight)
+            return -_weighted_mean(jnp.minimum(-logits - 1.0, 0.0),
+                                   sample_weight)
+        return -_weighted_mean(logits, sample_weight)
     if mode == "wasserstein":
-        return -jnp.mean(logits) if t_real else jnp.mean(logits)
+        m = _weighted_mean(logits, sample_weight)
+        return -m if t_real else m
     raise ValueError(f"Unexpected gan_mode {mode!r}")
 
 
 def gan_loss(dis_output, t_real, gan_mode="hinge", dis_update=True,
-             target_real_label=1.0, target_fake_label=0.0):
+             target_real_label=1.0, target_fake_label=0.0,
+             sample_weight=None):
     """GAN loss over a single logits array or a list of per-scale arrays.
 
     Args:
@@ -48,13 +64,16 @@ def gan_loss(dis_output, t_real, gan_mode="hinge", dis_update=True,
         t_real: target is the real label (static Python bool).
         gan_mode: 'hinge' | 'least_square' | 'non_saturated' | 'wasserstein'.
         dis_update: True → discriminator form, False → generator form.
+        sample_weight: optional (B,) validity weights (region Ds).
     """
     if isinstance(dis_output, (list, tuple)):
         per_scale = [
             _single_gan_loss(o, t_real, gan_mode, dis_update,
-                             target_real_label, target_fake_label)
+                             target_real_label, target_fake_label,
+                             sample_weight)
             for o in dis_output
         ]
         return sum(per_scale) / len(per_scale)
     return _single_gan_loss(dis_output, t_real, gan_mode, dis_update,
-                            target_real_label, target_fake_label)
+                            target_real_label, target_fake_label,
+                            sample_weight)

@@ -260,3 +260,160 @@ def extract_valid_pose_labels(pose_map, pose_type, remove_face_labels,
         densepose = jnp.where(face, -1.0, densepose)
         pose_map = jnp.concatenate([densepose, openpose], axis=-1)
     return pose_map
+
+
+# --------------------------------------------------------------- region crops
+# Output-side face/hand crops feeding the per-region additional
+# discriminators (ref: fs_vid2vid.py:631-779). The reference computes
+# per-sample bboxes on the host and crops with dynamic sizes; here
+# everything stays inside the jitted step with static shapes: bbox
+# min/max reductions over coordinate grids, a variable box -> fixed
+# output resample via jax.image.scale_and_translate (face), and
+# fixed-size lax.dynamic_slice windows (hands). Samples with no
+# detected region fall back to a default box and are flagged in a
+# validity mask so losses can be weighted instead of skipped.
+
+
+def _masked_minmax(mask):
+    """(B, H, W) bool -> per-sample ys, ye, xs, xe, count (int32)."""
+    b, h, w = mask.shape
+    yy = jnp.arange(h, dtype=jnp.int32)[None, :, None]
+    xx = jnp.arange(w, dtype=jnp.int32)[None, None, :]
+    big = jnp.int32(1 << 30)
+    ys = jnp.min(jnp.where(mask, yy, big), axis=(1, 2))
+    ye = jnp.max(jnp.where(mask, yy, -1), axis=(1, 2))
+    xs = jnp.min(jnp.where(mask, xx, big), axis=(1, 2))
+    xe = jnp.max(jnp.where(mask, xx, -1), axis=(1, 2))
+    count = jnp.sum(mask.astype(jnp.int32), axis=(1, 2))
+    return ys, ye, xs, xe, count
+
+
+def _latest_frame(pose):
+    if pose.ndim == 5:
+        pose = pose[:, -1]
+    return pose
+
+
+def _use_openpose(data_cfg):
+    from imaginaire_tpu.config import cfg_get
+
+    labels = list(cfg_get(data_cfg, "input_labels", None) or [])
+    return "pose_maps-densepose" not in labels
+
+
+def get_face_bbox_for_output(data_cfg, pose, crop_smaller=0):
+    """Per-sample face bbox [ys, ye, xs, xe] as a (B, 4) int32 array
+    (ref: fs_vid2vid.py:661-715). OpenPose one-hot labels put the face
+    stroke in the last channel; densepose marks face parts near the top
+    of the normalized part-index channel."""
+    pose = _latest_frame(pose)
+    b, h, w, _ = pose.shape
+    if _use_openpose(data_cfg):
+        mask = pose[..., -1] > 0.1
+    else:
+        mask = pose[..., 2] > 0.9
+    ys0, ye0, xs0, xe0, count = _masked_minmax(mask)
+
+    if _use_openpose(data_cfg):
+        xc = (xs0 + xe0) // 2
+        yc = (ys0 * 3 + ye0 * 2) // 5
+        ylen = (xe0 - xs0) * 5 // 2
+    else:
+        xc = (xs0 + xe0) // 2
+        yc = (ys0 + ye0) // 2
+        ylen = (ye0 - ys0) * 5 // 4
+    ylen = jnp.clip(ylen, 32, min(w, h))
+
+    default_len = max(h // 32 * 8, 32)
+    found = count > 0
+    yc = jnp.where(found, yc, h // 4)
+    xc = jnp.where(found, xc, w // 2)
+    ylen = jnp.where(found, ylen, default_len)
+
+    half = ylen // 2
+    yc = jnp.clip(yc, half, h - 1 - half)
+    xc = jnp.clip(xc, half, w - 1 - half)
+    ys = yc - half + crop_smaller
+    ye = yc + half - crop_smaller
+    xs = xc - half + crop_smaller
+    xe = xc + half - crop_smaller
+    return jnp.stack([ys, ye, xs, xe], axis=-1)
+
+
+def crop_face_from_output(data_cfg, image, input_label, crop_smaller=0):
+    """Crop the face box out of ``image`` and resample it to the fixed
+    (H//32*8)² patch the face discriminator consumes
+    (ref: fs_vid2vid.py:631-658). Variable box -> fixed output is one
+    affine resample (scale_and_translate), so shapes stay static."""
+    if isinstance(image, (list, tuple)):
+        return [crop_face_from_output(data_cfg, im, input_label,
+                                      crop_smaller) for im in image]
+    boxes = get_face_bbox_for_output(data_cfg, input_label, crop_smaller)
+    h = image.shape[-3]
+    size = max(h // 32 * 8, 8)
+
+    def crop_one(img, box):
+        ys, ye, xs, xe = box[0], box[1], box[2], box[3]
+        sy = size / jnp.maximum(ye - ys, 1).astype(jnp.float32)
+        sx = size / jnp.maximum(xe - xs, 1).astype(jnp.float32)
+        scale = jnp.stack([sy, sx])
+        translation = jnp.stack([-ys.astype(jnp.float32) * sy,
+                                 -xs.astype(jnp.float32) * sx])
+        return jax.image.scale_and_translate(
+            img[..., -3:], (size, size, 3), (0, 1), scale, translation,
+            method="linear")
+
+    return jax.vmap(crop_one)(image, boxes)
+
+
+def get_hand_bbox_for_output(data_cfg, pose):
+    """Fixed-size hand windows: centers + validity per hand
+    (ref: fs_vid2vid.py:744-779). Returns ((B, 2) yc, (B, 2) xc,
+    (B, 2) valid bool) for [left, right]; one-hot openpose labels put
+    the hand strokes in channels -3 (left) and -2 (right)."""
+    pose = _latest_frame(pose)
+    b, h, w, c = pose.shape
+    size = max(h // 64 * 8, 8)
+    half = size // 2
+    ycs, xcs, valids = [], [], []
+    for idx in (-3, -2):
+        mask = pose[..., idx] > 0.1
+        ys0, ye0, xs0, xe0, count = _masked_minmax(mask)
+        yc = (ys0 + ye0) // 2
+        xc = (xs0 + xe0) // 2
+        found = count > 0
+        yc = jnp.where(found, yc, h // 2)
+        xc = jnp.where(found, xc, w // 2)
+        ycs.append(jnp.clip(yc, half, h - 1 - half))
+        xcs.append(jnp.clip(xc, half, w - 1 - half))
+        valids.append(found)
+    return (jnp.stack(ycs, -1), jnp.stack(xcs, -1), jnp.stack(valids, -1))
+
+
+def crop_hand_from_output(data_cfg, image, input_label):
+    """Crop both hand windows out of ``image``.
+
+    Returns (crops, valid): crops (2B, S, S, 3) with both hands stacked
+    on the batch axis, valid (2B,) float mask — the reference instead
+    *skips* absent hands host-side (fs_vid2vid.py:718-742), which is a
+    dynamic shape; the mask keeps the jitted step static and the loss
+    exact."""
+    if isinstance(image, (list, tuple)):
+        return [crop_hand_from_output(data_cfg, im, input_label)
+                for im in image]
+    ycs, xcs, valid = get_hand_bbox_for_output(data_cfg, input_label)
+    h = image.shape[-3]
+    size = max(h // 64 * 8, 8)
+    half = size // 2
+
+    def crop_one(img, yc, xc):
+        return jax.lax.dynamic_slice(
+            img[..., -3:], (yc - half, xc - half, 0),
+            (size, size, 3))
+
+    crops = []
+    for i in range(2):
+        crops.append(jax.vmap(crop_one)(image, ycs[:, i], xcs[:, i]))
+    crops = jnp.concatenate(crops, axis=0)
+    valid = jnp.concatenate([valid[:, 0], valid[:, 1]], axis=0)
+    return crops, valid.astype(jnp.float32)

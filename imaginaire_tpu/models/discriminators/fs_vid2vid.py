@@ -31,6 +31,14 @@ from imaginaire_tpu.utils.data import (
 )
 
 
+def _resolve_crop_func(spec):
+    """'module::function' -> callable (ref: fs_vid2vid.py:112-115)."""
+    import importlib
+
+    module, fn_name = str(spec).split("::")
+    return getattr(importlib.import_module(module), fn_name)
+
+
 def _make_patch_dis(dis_cfg, name):
     dis_cfg = as_attrdict(dis_cfg or {})
     return MultiResPatchDiscriminator(
@@ -64,6 +72,19 @@ class Discriminator(nn.Module):
         for n in range(self.num_scales):
             temporal_ds.append(_make_patch_dis(temporal_cfg, f"net_DT{n}"))
         self.temporal_ds = temporal_ds
+        # Per-region additional discriminators (face/hand crops of G's
+        # output, ref: discriminators/fs_vid2vid.py:105-135).
+        add_cfg = cfg_get(dis_cfg, "additional_discriminators", None)
+        add_cfg = as_attrdict(add_cfg) if add_cfg else {}
+        self.add_dis_names = sorted(add_cfg.keys())
+        # flax freezes dicts assigned in setup: keep only the crop-func
+        # spec strings, the configs are consumed here and now
+        self.add_crop_funcs = [
+            str(cfg_get(as_attrdict(add_cfg[n]), "crop_func", ""))
+            for n in self.add_dis_names]
+        self.add_ds = [
+            _make_patch_dis(as_attrdict(add_cfg[n]), f"net_D_{n}")
+            for n in self.add_dis_names]
 
     def _discriminate_image(self, net_D, real_A, real_B, fake_B, training):
         """(ref: fs_vid2vid.py:160-174). Returns per-scale output dicts."""
@@ -95,6 +116,33 @@ class Discriminator(nn.Module):
 
         output = {"indv": self._discriminate_image(
             self.net_D, label, real_image, fake_image, training)}
+
+        # Region discriminators crop from the *clean* pose label (the
+        # reference crops from the label after the few-shot reference
+        # concat, so its channel indexing lands inside ref_image —
+        # deliberately not reproduced).
+        pose_label = data["label"]
+        if pose_label is not None and pose_label.ndim == 5:
+            pose_label = pose_label[:, -1]
+        for i, name in enumerate(self.add_dis_names):
+            crop_fn = _resolve_crop_func(self.add_crop_funcs[i])
+            real_crop = crop_fn(self.data_cfg, real_image, pose_label)
+            fake_crop = crop_fn(self.data_cfg, fake_image, pose_label)
+            valid = None
+            if isinstance(real_crop, tuple):
+                real_crop, valid = real_crop
+                fake_crop, _ = fake_crop
+            if self.use_few_shot:
+                ref_crop = crop_fn(self.data_cfg, ref_image, pose_label)
+                if isinstance(ref_crop, tuple):
+                    ref_crop = ref_crop[0]
+                real_crop = jnp.concatenate([real_crop, ref_crop], axis=-1)
+                fake_crop = jnp.concatenate([fake_crop, ref_crop], axis=-1)
+            out_i = self._discriminate_image(
+                self.add_ds[i], None, real_crop, fake_crop, training)
+            if valid is not None:
+                out_i["valid"] = valid
+            output[name] = out_i
 
         if net_G_output.get("fake_raw_images") is not None:
             fg_mask = get_fg_mask(data["label"], self.has_fg)

@@ -66,6 +66,7 @@ class Trainer(Vid2VidTrainer):
                                                   dis_update=False)
                 losses[f"GAN_T{s}"] = gan_t
                 losses[f"FeatureMatching_T{s}"] = fm_t
+        losses = self._region_d_losses(d_out, losses, dis_update=False)
         return losses, new_mut, out
 
     def dis_forward(self, vars_G, vars_D, loss_params, data, rng,
@@ -83,6 +84,7 @@ class Trainer(Vid2VidTrainer):
                 gan_t, _ = self._gan_fm_losses(d_out[f"temporal_{s}"],
                                                dis_update=True)
                 losses[f"GAN_T{s}"] = gan_t
+        losses = self._region_d_losses(d_out, losses, dis_update=True)
         return losses, new_mut_D
 
     def _get_visualizations(self, data):

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from imaginaire_tpu.config import cfg_get
+from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.losses import PerceptualLoss, feature_matching_loss, gan_loss
 from imaginaire_tpu.losses.flow import masked_l1_loss
 from imaginaire_tpu.model_utils.fs_vid2vid import concat_frames, skip_stride_span
@@ -101,6 +101,16 @@ class Trainer(BaseTrainer):
         for s in range(self.num_temporal_scales):
             self.weights[f"GAN_T{s}"] = cfg_get(lw, "temporal_gan", 0)
             self.weights[f"FeatureMatching_T{s}"] = lw.feature_matching
+        # Per-region additional discriminators: each carries its own
+        # loss_weight (ref: trainers/vid2vid.py:120-129, configs'
+        # additional_discriminators blocks).
+        add_cfg = cfg_get(cfg.dis, "additional_discriminators", None)
+        add_cfg = as_attrdict(add_cfg) if add_cfg else {}
+        self.add_dis_names = sorted(add_cfg.keys())
+        for name in self.add_dis_names:
+            self.weights[f"GAN_{name}"] = cfg_get(add_cfg[name],
+                                                  "loss_weight", 1.0)
+            self.weights[f"FeatureMatching_{name}"] = lw.feature_matching
 
     def init_loss_params(self, key):
         params = {}
@@ -208,18 +218,37 @@ class Trainer(BaseTrainer):
                                     mutable=list(MUTABLE), **kwargs)
         return self.net_D.apply(vars_D, data_t, out, **kwargs)
 
-    def _gan_fm_losses(self, d_out_part, dis_update):
-        """(ref: trainers/vid2vid.py:609-635)."""
+    def _gan_fm_losses(self, d_out_part, dis_update, sample_weight=None):
+        """(ref: trainers/vid2vid.py:609-635). ``sample_weight`` carries
+        the region-validity mask of additional discriminators."""
         fake = d_out_part["pred_fake"]
         real = d_out_part["pred_real"]
         if dis_update:
             gan = 0.5 * (
-                gan_loss(fake["outputs"], False, self.gan_mode, True)
-                + gan_loss(real["outputs"], True, self.gan_mode, True))
+                gan_loss(fake["outputs"], False, self.gan_mode, True,
+                         sample_weight=sample_weight)
+                + gan_loss(real["outputs"], True, self.gan_mode, True,
+                           sample_weight=sample_weight))
             return gan, None
-        gan = gan_loss(fake["outputs"], True, self.gan_mode, False)
-        fm = feature_matching_loss(fake["features"], real["features"])
+        gan = gan_loss(fake["outputs"], True, self.gan_mode, False,
+                       sample_weight=sample_weight)
+        fm = feature_matching_loss(fake["features"], real["features"],
+                                   sample_weight=sample_weight)
         return gan, fm
+
+    def _region_d_losses(self, d_out, losses, dis_update):
+        """Collect per-region (face/hand) GAN/FM losses; the validity
+        mask of fixed-shape region crops weights out absent regions
+        (ref: trainers/vid2vid.py additional-D loss collection)."""
+        for name in self.add_dis_names:
+            if name in d_out:
+                gan_r, fm_r = self._gan_fm_losses(
+                    d_out[name], dis_update=dis_update,
+                    sample_weight=d_out[name].get("valid"))
+                losses[f"GAN_{name}"] = gan_r
+                if not dis_update:
+                    losses[f"FeatureMatching_{name}"] = fm_r
+        return losses
 
     def _split_data_t(self, data):
         data = dict(data)
@@ -284,6 +313,7 @@ class Trainer(BaseTrainer):
                                                   dis_update=False)
                 losses[f"GAN_T{s}"] = gan_t
                 losses[f"FeatureMatching_T{s}"] = fm_t
+        losses = self._region_d_losses(d_out, losses, dis_update=False)
         return losses, new_mut, out
 
     def dis_forward(self, vars_G, vars_D, loss_params, data, rng,
@@ -305,6 +335,7 @@ class Trainer(BaseTrainer):
                 gan_t, _ = self._gan_fm_losses(d_out[f"temporal_{s}"],
                                                dis_update=True)
                 losses[f"GAN_T{s}"] = gan_t
+        losses = self._region_d_losses(d_out, losses, dis_update=True)
         return losses, new_mut_D
 
     # --------------------------------------------------------- jitted steps

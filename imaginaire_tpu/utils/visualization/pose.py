@@ -139,8 +139,17 @@ def connect_pose_keypoints(pts, edge_lists, size, basic_points_only=False,
 def openpose_to_npy(inputs, return_largest_only=False):
     """Decode OpenPose JSON dicts into per-person keypoint arrays
     (ref: pose.py:75-141). Returns the dict for the largest person when
-    requested (multi-person frames pick the tallest skeleton)."""
-    people = inputs.get("people", []) if isinstance(inputs, dict) else inputs
+    requested (multi-person frames pick the tallest skeleton). A list
+    input (the data pipeline's frame list, ref convert:: op grammar)
+    maps per frame."""
+    if isinstance(inputs, list):
+        if inputs and isinstance(inputs[0], dict) \
+                and "pose_keypoints_2d" in inputs[0]:
+            people = inputs  # bare people list: one frame
+        else:  # frame list from the data pipeline
+            return [openpose_to_npy(f, return_largest_only) for f in inputs]
+    else:
+        people = inputs.get("people", [])
     decoded = []
     for person in people:
         entry = {
