@@ -177,34 +177,94 @@ class PackedBackend:
                 for buf, (_, _, ext) in zip(bufs, entries)]
 
 
+def _walk_dataset_files(data_root, data_types, sequence_files):
+    """Yield (data_type, seq, stem, ext, raw bytes) over the
+    ``data_root/<data_type>/<sequence>/<file>`` tree in sorted order,
+    recording {seq: [stems]} into ``sequence_files`` — the shared walk
+    of both builder formats (ref: utils/lmdb.py:56-129)."""
+    seen = {}
+    for data_type in data_types:
+        type_root = os.path.join(data_root, data_type)
+        for seq in sorted(os.listdir(type_root)):
+            seq_dir = os.path.join(type_root, seq)
+            if not os.path.isdir(seq_dir):
+                continue
+            for fname in sorted(os.listdir(seq_dir)):
+                stem, ext = os.path.splitext(fname)
+                with open(os.path.join(seq_dir, fname), "rb") as f:
+                    buf = f.read()
+                if stem not in seen.setdefault(seq, set()):
+                    seen[seq].add(stem)
+                    sequence_files.setdefault(seq, []).append(stem)
+                yield data_type, seq, stem, ext.lstrip("."), buf
+
+
 def build_packed_dataset(data_root, out_root, data_types):
     """Pack ``data_root/<data_type>/<sequence>/<file>`` trees into one
     blob per data type + all_filenames.json (the builder contract of
     ref: utils/lmdb.py:56-129 / scripts/build_lmdb.py:40-125)."""
     os.makedirs(out_root, exist_ok=True)
     sequence_files = {}
+    outs, indices = {}, {}
     for data_type in data_types:
-        type_root = os.path.join(data_root, data_type)
         type_out = os.path.join(out_root, data_type)
         os.makedirs(type_out, exist_ok=True)
-        index = {}
-        with open(os.path.join(type_out, "data.bin"), "wb") as out:
-            for seq in sorted(os.listdir(type_root)):
-                seq_dir = os.path.join(type_root, seq)
-                if not os.path.isdir(seq_dir):
-                    continue
-                for fname in sorted(os.listdir(seq_dir)):
-                    stem, ext = os.path.splitext(fname)
-                    key = f"{seq}/{stem}"
-                    with open(os.path.join(seq_dir, fname), "rb") as f:
-                        buf = f.read()
-                    index[key] = [out.tell(), len(buf), ext.lstrip(".")]
-                    out.write(buf)
-                    sequence_files.setdefault(seq, [])
-                    if stem not in sequence_files[seq]:
-                        sequence_files[seq].append(stem)
-        with open(os.path.join(type_out, "index.json"), "w") as f:
-            json.dump(index, f)
+        outs[data_type] = open(os.path.join(type_out, "data.bin"), "wb")
+        indices[data_type] = {}
+    try:
+        for data_type, seq, stem, ext, buf in _walk_dataset_files(
+                data_root, data_types, sequence_files):
+            out = outs[data_type]
+            indices[data_type][f"{seq}/{stem}"] = [out.tell(), len(buf),
+                                                   ext]
+            out.write(buf)
+    finally:
+        for f in outs.values():
+            f.close()
+    for data_type in data_types:
+        with open(os.path.join(out_root, data_type, "index.json"),
+                  "w") as f:
+            json.dump(indices[data_type], f)
+    with open(os.path.join(out_root, "all_filenames.json"), "w") as f:
+        json.dump(sequence_files, f)
+    return out_root
+
+
+def build_lmdb_dataset(data_root, out_root, data_types, map_size=1 << 40):
+    """Write the reference's LMDB layout: one readonly LMDB per data
+    type (key = 'sequence/stem', value = raw encoded bytes) plus
+    metadata.json (extension) and all_filenames.json
+    (ref: utils/lmdb.py:56-129, scripts/build_lmdb.py:40-125). Gated on
+    the ``lmdb`` package; PackedBackend is the dependency-free
+    equivalent."""
+    try:
+        import lmdb
+    except ImportError as e:
+        raise ImportError(
+            "The 'lmdb' package is not installed; use --format packed "
+            "(build_packed_dataset) instead.") from e
+    os.makedirs(out_root, exist_ok=True)
+    sequence_files = {}
+    envs, txns, ext_seen = {}, {}, {}
+    for data_type in data_types:
+        type_out = os.path.join(out_root, data_type)
+        os.makedirs(type_out, exist_ok=True)
+        envs[data_type] = lmdb.open(type_out, map_size=map_size)
+        txns[data_type] = envs[data_type].begin(write=True)
+    try:
+        for data_type, seq, stem, ext, buf in _walk_dataset_files(
+                data_root, data_types, sequence_files):
+            txns[data_type].put(f"{seq}/{stem}".encode(), buf)
+            ext_seen[data_type] = ext or ext_seen.get(data_type)
+        for txn in txns.values():
+            txn.commit()
+    finally:
+        for env in envs.values():
+            env.close()
+    for data_type in data_types:
+        meta = os.path.join(out_root, data_type, "metadata.json")
+        with open(meta, "w") as f:
+            json.dump({"ext": ext_seen.get(data_type)}, f)
     with open(os.path.join(out_root, "all_filenames.json"), "w") as f:
         json.dump(sequence_files, f)
     return out_root

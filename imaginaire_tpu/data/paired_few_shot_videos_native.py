@@ -58,21 +58,8 @@ def decode_video_frames(buf_or_path, frame_indices=None, num_random=2,
         if not cap.isOpened():
             raise ValueError("cv2.VideoCapture failed to open clip")
         n = int(cap.get(cv2.CAP_PROP_FRAME_COUNT))
-        if n <= 0:
-            # some containers don't report frame count; count by decoding
-            frames_all = []
-            while True:
-                ok, frame = cap.read()
-                if not ok:
-                    break
-                frames_all.append(frame)
-            n = len(frames_all)
-            if n == 0:
-                raise ValueError("empty video clip")
-            idxs = _choose_indices(n, frame_indices, num_random,
-                                   first_last_only, rng)
-            out = [frames_all[i] for i in idxs]
-        else:
+        out = None
+        if n > 0:
             idxs = _choose_indices(n, frame_indices, num_random,
                                    first_last_only, rng)
             out = []
@@ -80,8 +67,51 @@ def decode_video_frames(buf_or_path, frame_indices=None, num_random=2,
                 cap.set(cv2.CAP_PROP_POS_FRAMES, i)
                 ok, frame = cap.read()
                 if not ok:
-                    raise ValueError(f"failed to decode frame {i}/{n}")
+                    # container over-reported its frame count (VFR /
+                    # truncated GOP): fall back to sequential decode
+                    out = None
+                    break
                 out.append(frame)
+        if out is None:
+            # No (reliable) frame count. Stream instead of buffering the
+            # whole clip (a long 1080p clip decoded wholesale is tens of
+            # GB): first/last keeps 2 frames; random/indexed counts in a
+            # first pass, then keeps only the chosen frames.
+            cap.release()
+            cap = cv2.VideoCapture(path)
+            if first_last_only and frame_indices is None:
+                first = last = None
+                while True:
+                    ok, frame = cap.read()
+                    if not ok:
+                        break
+                    if first is None:
+                        first = frame
+                    last = frame
+                if first is None:
+                    raise ValueError("empty video clip")
+                out = [first, last]
+            else:
+                n = 0
+                while cap.grab():
+                    n += 1
+                if n == 0:
+                    raise ValueError("empty video clip")
+                idxs = _choose_indices(n, frame_indices, num_random,
+                                       first_last_only, rng)
+                wanted = {}
+                cap.release()
+                cap = cv2.VideoCapture(path)
+                for i in range(max(idxs) + 1):
+                    ok, frame = cap.read()
+                    if not ok:
+                        break
+                    if i in idxs:
+                        wanted[i] = frame
+                missing = [i for i in idxs if i not in wanted]
+                if missing:
+                    raise ValueError(f"failed to decode frames {missing}")
+                out = [wanted[i] for i in idxs]
         cap.release()
         return [cv2.cvtColor(f, cv2.COLOR_BGR2RGB) for f in out]
     finally:
@@ -99,6 +129,17 @@ def _choose_indices(n, frame_indices, num_random, first_last_only, rng):
     while len(idxs) < num_random:  # clip shorter than requested draws
         idxs.append(idxs[-1])
     return idxs
+
+
+def _resize_target(augmentor):
+    """The augmentation pipeline's output (h, w), if a resize key pins
+    one; used to size blank fallback frames consistently."""
+    cfg = getattr(augmentor, "cfg", {}) or {}
+    for key in ("random_crop_h_w", "center_crop_h_w", "resize_h_w"):
+        if key in cfg:
+            h, w = str(cfg[key]).split(",")
+            return int(h), int(w)
+    return None
 
 
 class Dataset(BaseDataset):
@@ -119,6 +160,23 @@ class Dataset(BaseDataset):
     def __len__(self):
         return self.epoch_length
 
+    def _probe_clip_hw(self):
+        """Frame size from another clip's container header (no full
+        decode), so blank fallbacks match healthy items' shape even when
+        the very first item of the run is the corrupt one."""
+        import cv2
+
+        for root_idx, seq, fname in self.mapping[:8]:
+            try:
+                blob = self.load_item(root_idx, seq, [fname])[
+                    self.video_data_type][0]
+                frames = decode_video_frames(blob, frame_indices=[0])
+                self._last_good_hw = frames[0].shape[:2]
+                return self._last_good_hw
+            except Exception:  # noqa: BLE001
+                continue
+        return None
+
     def num_inference_sequences(self):
         return len(self.mapping)
 
@@ -132,10 +190,19 @@ class Dataset(BaseDataset):
         try:
             frames = decode_video_frames(
                 blob, first_last_only=self.first_last_only)
+            self._last_good_hw = frames[0].shape[:2]
         except Exception as e:  # noqa: BLE001 — degrade, don't kill the run
             print(f"paired_few_shot_videos_native: bad clip "
                   f"{sequence_name}/{filename}: {e}")
-            blank = np.zeros((512, 512, 3), dtype=np.uint8)
+            # Match healthy items' shape so batch collation survives:
+            # prefer the last decoded clip's size, else the config's
+            # resize target, else probe another clip's header, else the
+            # reference's 512 default
+            # (ref: paired_few_shot_videos_native.py:157-161).
+            h, w = getattr(self, "_last_good_hw", None) \
+                or _resize_target(self.augmentor) \
+                or self._probe_clip_hw() or (512, 512)
+            blank = np.zeros((h, w, 3), dtype=np.uint8)
             frames = [blank, blank.copy()]
         raw[vt] = frames
         # non-video data types carry one entry per clip; replicate across

@@ -417,3 +417,27 @@ def crop_hand_from_output(data_cfg, image, input_label):
     crops = jnp.concatenate(crops, axis=0)
     valid = jnp.concatenate([valid[:, 0], valid[:, 1]], axis=0)
     return crops, valid.astype(jnp.float32)
+
+
+def roll(t, ny, nx, flip=False):
+    """Roll a (..., H, W, C) array by (ny, nx) with optional horizontal
+    flip (ref: fs_vid2vid.py:832-849, NHWC here instead of NCHW)."""
+    t = jnp.roll(t, (ny, nx), axis=(-3, -2))
+    if flip:
+        t = t[..., ::-1, :]
+    return t
+
+
+def random_roll(tensors, rng=None):
+    """Randomly roll a list of (..., H, W, C) arrays along y/x (up to
+    H/16, W/16, from either edge) and randomly flip — the pose-map
+    augmentation (ref: fs_vid2vid.py:814-830). The draw is host-side
+    (numpy) so every tensor in the batch shares one geometry."""
+    rng = rng or np.random
+    h, w = np.asarray(tensors[0]).shape[-3:-1]
+    ny = int(rng.choice([rng.randint(max(h // 16, 1)),
+                         h - rng.randint(max(h // 16, 1))]))
+    nx = int(rng.choice([rng.randint(max(w // 16, 1)),
+                         w - rng.randint(max(w // 16, 1))]))
+    flip = rng.rand() > 0.5
+    return [roll(t, ny, nx, flip) for t in tensors]

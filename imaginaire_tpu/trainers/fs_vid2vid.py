@@ -87,6 +87,76 @@ class Trainer(Vid2VidTrainer):
         losses = self._region_d_losses(d_out, losses, dis_update=True)
         return losses, new_mut_D
 
+    # ------------------------------------------------- inference finetune
+
+    def finetune(self, data, inference_args=None):
+        """Adapt the model to the K reference frames at inference time
+        (ref: trainers/fs_vid2vid.py:264-292): restrict G updates to the
+        weight-generator FCs / output conv / up-ladder, then run a few
+        D+G iterations on randomly rolled+flipped reference targets.
+        random_roll supplies the shift/flip augmentation the reference
+        uses to avoid overfitting the handful of frames."""
+        import optax
+
+        from imaginaire_tpu.config import cfg_get
+        from imaginaire_tpu.model_utils.fs_vid2vid import random_roll
+
+        inference_args = inference_args or {}
+        prefixes = tuple(cfg_get(inference_args, "finetune_param_prefixes",
+                                 None)
+                         or ("weight_generator", "conv_img", "up"))
+        iterations = int(cfg_get(inference_args, "finetune_iter", 100))
+
+        def _mask(path, _):
+            names = [p.key for p in path if hasattr(p, "key")]
+            return any(str(n).startswith(pref)
+                       for n in names for pref in prefixes)
+
+        params_G = self.state["vars_G"]["params"]
+        mask = jax.tree_util.tree_map_with_path(_mask, params_G)
+        inv_mask = jax.tree_util.tree_map(lambda m: not m, mask)
+        # masked() leaves unmasked updates untouched — zero them
+        # explicitly so frozen params stay frozen
+        self.tx_G = optax.chain(
+            optax.masked(optax.set_to_zero(), inv_mask),
+            optax.masked(self.tx_G, mask))
+        self.state["opt_G"] = self.tx_G.init(params_G)
+        self.state["opt_D"] = self.tx_D.init(
+            self.state["vars_D"]["params"])
+        # the step programs closed over the old optimizer: re-trace
+        self._jit_vid_dis = jax.jit(self._vid_dis_step_fn, donate_argnums=0)
+        self._jit_vid_gen = jax.jit(self._vid_gen_step_fn, donate_argnums=0)
+
+        ref_labels = data["ref_labels"]
+        ref_images = data["ref_images"]
+        k = ref_images.shape[1]
+        import numpy as np
+
+        for it in range(1, iterations + 1):
+            idx = int(np.random.randint(k))
+            tgt_label, tgt_image = random_roll(
+                [ref_labels[:, idx], ref_images[:, idx]])
+            d = dict(data)
+            d["label"] = tgt_label[:, None]
+            d["images"] = tgt_image[:, None]
+            # gen_update runs the interleaved D+G rollout (dis_update is
+            # a no-op by the vid2vid contract)
+            self.gen_update(d)
+        self.has_finetuned = True
+
+    def test(self, data_loader, output_dir, inference_args=None):
+        """(ref: trainers/fs_vid2vid.py:240-262): optional few-shot
+        finetune on the first batch's reference frames before testing."""
+        inference_args = dict(inference_args or {})
+        if inference_args.pop("finetune", False) \
+                and not getattr(self, "has_finetuned", False):
+            first = next(iter(data_loader))
+            first = self.start_of_iteration(first, current_iteration=-1)
+            self.finetune(first, inference_args)
+        inference_args.pop("finetune_iter", None)
+        inference_args.pop("finetune_param_prefixes", None)
+        return super().test(data_loader, output_dir, inference_args)
+
     def _get_visualizations(self, data):
         """(ref: trainers/fs_vid2vid.py:196-260)."""
         data = to_device(numeric_only(dict(data)))

@@ -490,6 +490,57 @@ class Trainer(BaseTrainer):
         cloud here). Default: no-op."""
         pass
 
+    def _start_of_test_sequence(self, data):
+        """Hook before generating a test sequence (wc-vid2vid resets its
+        renderer here, ref: trainers/wc_vid2vid.py:70-87). No-op."""
+        pass
+
+    def test(self, data_loader, output_dir, inference_args=None):
+        """Frame-by-frame video generation over each test sequence
+        (ref: trainers/vid2vid.py:330-417): carry the previous labels
+        and *generated* frames through the rollout, write one JPEG per
+        frame under <output_dir>/<key>/."""
+        import os
+
+        from imaginaire_tpu.utils.visualization import (
+            save_image_grid,
+            tensor2im,
+        )
+
+        os.makedirs(output_dir, exist_ok=True)
+        variables = self.inference_params()
+        for it, data in enumerate(data_loader):
+            data = self.start_of_iteration(data, current_iteration=-1)
+            key = data.get("key", f"{it:06d}")
+            if isinstance(key, (list, tuple)):
+                key = key[0]
+            if not isinstance(key, (str, bytes)):
+                key = f"{it:06d}"
+            data = numeric_only(data)
+            self._start_of_test_sequence(data)
+            seq_len = (data["images"].shape[1]
+                       if data["images"].ndim == 5 else 1)
+            prev_labels = prev_images = None
+            for t in range(seq_len):
+                data_t = self._get_data_t(data, t, prev_labels,
+                                          prev_images)
+                out, _ = self._apply_G(
+                    variables, {k: v for k, v in data_t.items()
+                                if not k.startswith("_")},
+                    jax.random.PRNGKey(it * 10007 + t), training=False)
+                fake = out["fake_images"]
+                self._after_gen_frame(data_t, fake)
+                prev_labels = concat_frames(prev_labels, data_t["label"],
+                                            self.num_frames_G - 1)
+                prev_images = concat_frames(prev_images, fake,
+                                            self.num_frames_G - 1)
+                path = os.path.join(output_dir, str(key),
+                                    f"{t:04d}.jpg")
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                save_image_grid(
+                    [tensor2im(np.asarray(jax.device_get(fake))[0])],
+                    path)
+
     def dis_update(self, data):
         """D updates happen inside gen_update's rollout
         (ref: trainers/vid2vid.py:290-296)."""

@@ -82,6 +82,13 @@ class Trainer(Vid2VidTrainer):
                             if flipped is not None else False)
         return super().gen_update(data)
 
+    def _start_of_test_sequence(self, data):
+        """Fresh point cloud per test sequence
+        (ref: trainers/wc_vid2vid.py:70-87)."""
+        flipped = data.get("is_flipped")
+        self.reset_renderer(bool(np.asarray(flipped).any())
+                            if flipped is not None else False)
+
     def _after_gen_frame(self, data_t, fake):
         """Color the point cloud with the freshly generated frame."""
         infos = data_t.get("_point_infos")

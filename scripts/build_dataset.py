@@ -18,7 +18,10 @@ import sys
 
 sys.path.insert(0, ".")
 
-from imaginaire_tpu.data.backends import build_packed_dataset  # noqa: E402
+from imaginaire_tpu.data.backends import (  # noqa: E402
+    build_lmdb_dataset,
+    build_packed_dataset,
+)
 
 
 def main():
@@ -27,11 +30,17 @@ def main():
     parser.add_argument("--output_root", required=True)
     parser.add_argument("--input_types", required=True,
                         help="comma-separated data type folder names")
+    parser.add_argument("--format", choices=("packed", "lmdb"),
+                        default="packed",
+                        help="packed = TPU-native shard (no deps); "
+                             "lmdb = the reference's LMDB layout "
+                             "(needs the lmdb package)")
     args = parser.parse_args()
-    out = build_packed_dataset(args.data_root, args.output_root,
-                               [t.strip() for t in
-                                args.input_types.split(",")])
-    print(f"Packed dataset written to {out}")
+    build = build_packed_dataset if args.format == "packed" \
+        else build_lmdb_dataset
+    out = build(args.data_root, args.output_root,
+                [t.strip() for t in args.input_types.split(",")])
+    print(f"{args.format} dataset written to {out}")
 
 
 if __name__ == "__main__":

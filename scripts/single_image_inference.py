@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Single-image inference driver (ref: my_inference.py:37-200, the
+fork-added manual driver; paths are CLI flags here instead of the
+fork's hard-coded Windows paths).
+
+Feeds ONE label map (and optional style image) through a trained
+generator and writes the synthesized JPEG:
+
+    python scripts/single_image_inference.py --config <cfg.yaml> \
+        --checkpoint <ckpt> --label seg.png --output out.jpg \
+        [--style style.jpg]
+
+The label file is read exactly like the training pipeline would
+(one-hot expansion with dont-care, normalization per config).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--checkpoint", default="")
+    parser.add_argument("--label", required=True,
+                        help="Path to the input label map image.")
+    parser.add_argument("--style", default=None,
+                        help="Optional style image for VAE-style encoders.")
+    parser.add_argument("--output", required=True)
+    parser.add_argument("--seed", type=int, default=0)
+    return parser.parse_args()
+
+
+def load_label(cfg, path):
+    """Read + preprocess one label image with the config's per-type
+    rules (one-hot w/ dont-care, augment to the val crop size)."""
+    import cv2
+
+    from imaginaire_tpu.config import cfg_get
+    from imaginaire_tpu.data.base import BaseDataset
+
+    arr = cv2.imread(path, cv2.IMREAD_UNCHANGED)
+    if arr is None:
+        raise FileNotFoundError(path)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    label_types = list(cfg_get(cfg.data, "input_labels", None) or [])
+    pieces = []
+    first_label_done = False
+    for t in cfg.data.input_types:
+        (name, info), = t.items()
+        if name not in label_types:
+            continue
+        num_ch = cfg_get(info, "num_channels", arr.shape[-1])
+        n_out = num_ch + (1 if cfg_get(info, "use_dont_care", False) else 0)
+        if first_label_done:
+            # only one label file is provided; later label types get
+            # zero channels so the tensor matches the trained net's
+            # channel budget (checkpoint shapes stay loadable)
+            pieces.append(np.zeros(arr.shape[:2] + (n_out,), np.float32))
+            continue
+        if num_ch > arr.shape[-1]:  # index map -> one-hot
+            piece = BaseDataset._encode_onehot(
+                arr.astype(np.float32), num_ch,
+                cfg_get(info, "use_dont_care", False))
+        else:
+            piece = arr.astype(np.float32)
+            if arr.dtype == np.uint8:
+                piece = piece / 255.0
+            if cfg_get(info, "normalize", False):
+                piece = piece * 2.0 - 1.0
+        pieces.append(piece)
+        first_label_done = True
+    return np.concatenate(pieces, axis=-1) if pieces else arr
+
+
+def main():
+    args = parse_args()
+    import jax
+
+    from imaginaire_tpu.config import Config, cfg_get
+    from imaginaire_tpu.registry import resolve
+    from imaginaire_tpu.utils.io import save_pilimage_in_jpeg
+    from imaginaire_tpu.utils.visualization.common import tensor2im
+
+    cfg = Config(args.config)
+    label = load_label(cfg, args.label)[None]  # (1, H, W, C)
+    data = {"label": label,
+            "images": np.zeros(label.shape[:3] + (3,), np.float32)}
+    if args.style:
+        import cv2
+
+        style = cv2.cvtColor(cv2.imread(args.style), cv2.COLOR_BGR2RGB)
+        style = cv2.resize(style, (label.shape[2], label.shape[1]))
+        data["images"] = (style.astype(np.float32) / 255.0 * 2 - 1)[None]
+
+    trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+    # trainer hook rounds H/W to the generator's size contract
+    data = trainer.start_of_iteration(data, 0)
+    trainer.init_state(jax.random.PRNGKey(args.seed), data)
+    if args.checkpoint:
+        trainer.load_checkpoint(args.checkpoint)
+    else:
+        print("WARNING: no --checkpoint given; using fresh weights.")
+
+    variables = trainer.inference_params()
+    net_G = trainer.net_G
+    inference_args = dict(cfg_get(cfg, "inference_args", None) or {})
+    out = net_G.apply(variables, data, method="inference",
+                      rngs={"noise": jax.random.PRNGKey(args.seed)},
+                      **inference_args)
+    fake = out["fake_images"] if isinstance(out, dict) else out
+    from PIL import Image
+
+    img = tensor2im(np.asarray(jax.device_get(fake)))[0]
+    os.makedirs(os.path.dirname(os.path.abspath(args.output)), exist_ok=True)
+    save_pilimage_in_jpeg(args.output, Image.fromarray(img))
+    print(f"Wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()

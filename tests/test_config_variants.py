@@ -90,3 +90,46 @@ def test_fs_vid2vid_pose_two_iterations(tmp_path):
     for name, v in g.items():
         assert np.isfinite(float(jax.device_get(v))), name
     assert "GAN_face" in g and "GAN_hand" in g
+
+
+@pytest.mark.slow
+def test_fs_vid2vid_inference_finetune(tmp_path):
+    """Few-shot inference-time finetune (ref: trainers/fs_vid2vid.py:
+    264-292): masked G updates on rolled reference frames; only the
+    weight-generator/up/conv_img params move."""
+    cfg = Config(os.path.join(CFGS, "fs_vid2vid.yaml"))
+    cfg.logdir = str(tmp_path)
+    rng = np.random.RandomState(0)
+
+    def img(k=1):
+        return jnp.asarray(rng.rand(1, k, 32, 32, 3).astype(np.float32)
+                           * 2 - 1)
+
+    batch = {"images": img(2),
+             "label": jnp.asarray((rng.rand(1, 2, 32, 32, 13) > 0.9)
+                                  .astype(np.float32)),
+             "ref_images": img(1),
+             "ref_labels": jnp.asarray((rng.rand(1, 1, 32, 32, 13) > 0.9)
+                                       .astype(np.float32))}
+    trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+    trainer.init_state(jax.random.PRNGKey(0), batch)
+    before = jax.tree_util.tree_map(
+        lambda x: np.array(x), trainer.state["vars_G"]["params"])
+    trainer.finetune(batch, {"finetune_iter": 1})
+    assert trainer.has_finetuned
+    after = trainer.state["vars_G"]["params"]
+    flat_b = jax.tree_util.tree_leaves_with_path(before)
+    flat_a = dict(jax.tree_util.tree_leaves_with_path(after))
+    moved = frozen = 0
+    for path, b in flat_b:
+        a = flat_a[path]
+        names = [str(p.key) for p in path if hasattr(p, "key")]
+        masked_in = any(n.startswith(pref) for n in names
+                        for pref in ("weight_generator", "conv_img", "up"))
+        changed = not np.allclose(np.asarray(a), b)
+        if masked_in:
+            moved += changed
+        else:
+            assert not changed, f"frozen param moved: {names}"
+            frozen += 1
+    assert moved > 0 and frozen > 0
