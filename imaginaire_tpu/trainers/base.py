@@ -439,10 +439,72 @@ class BaseTrainer:
     # ------------------------------------------------------------ inference
 
     def inference_params(self):
-        """EMA params when model averaging is on (ref: base.py:674-678)."""
+        """EMA params when model averaging is on (ref: base.py:674-678);
+        recalibrated BN stats when they have been estimated."""
         if self.model_average:
-            return dict(self.state["vars_G"], params=self.state["ema_G"])
+            variables = dict(self.state["vars_G"],
+                             params=self.state["ema_G"])
+            if getattr(self, "_ema_batch_stats", None) is not None:
+                variables["batch_stats"] = self._ema_batch_stats
+            return variables
         return self.state["vars_G"]
+
+    def recalculate_model_average_batch_norm_statistics(self,
+                                                        data_loader=None):
+        """Re-estimate the EMA model's BN running stats as the
+        cumulative mean of per-batch statistics over
+        ``model_average_batch_norm_estimation_iteration`` training
+        batches (ref: trainers/base.py:415-443 momentum=1/(n+1) loop,
+        utils/model_average.py:9-33). The per-batch statistic is
+        recovered from flax's linear running update
+        (new = m*old + (1-m)*batch, m=0.9 — the layer default)."""
+        if data_loader is None:
+            data_loader = self.train_data_loader
+        if not self.model_average or data_loader is None:
+            return
+        if getattr(self, "_ema_bn_recal_iter", None) == \
+                self.current_iteration:
+            return  # already estimated this iteration (FID + image save)
+        n_iters = cfg_get(self.cfg.trainer,
+                          "model_average_batch_norm_estimation_iteration",
+                          30)
+        old_stats = self.state["vars_G"].get("batch_stats")
+        if not n_iters or old_stats is None or not jax.tree_util.tree_leaves(
+                old_stats):
+            return
+        from imaginaire_tpu.utils.misc import numeric_only, to_device
+
+        momentum = 0.9
+        ema_vars = dict(self.state["vars_G"], params=self.state["ema_G"])
+        mean_stats = None
+        count = 0
+        rng = jax.random.PRNGKey(1234)
+        for it, data in enumerate(data_loader):
+            if it >= n_iters:
+                break
+            # side-effect-free preprocessing: start_of_iteration would
+            # reset timers / re-trigger the profiler window mid-metrics
+            data = to_device(self._start_of_iteration(
+                data, self.current_iteration))
+            _, new_mut = self._apply_G(ema_vars, numeric_only(data),
+                                       jax.random.fold_in(rng, it),
+                                       training=True)
+            new_stats = new_mut.get("batch_stats")
+            if new_stats is None:
+                return
+            batch_stat = jax.tree_util.tree_map(
+                lambda new, old: (new - momentum * old) / (1 - momentum),
+                new_stats, old_stats)
+            count += 1
+            if mean_stats is None:
+                mean_stats = batch_stat
+            else:
+                mean_stats = jax.tree_util.tree_map(
+                    lambda m, b: m + (b - m) / count, mean_stats,
+                    batch_stat)
+        if mean_stats is not None:
+            self._ema_batch_stats = mean_stats
+            self._ema_bn_recal_iter = self.current_iteration
 
     def test(self, data_loader, output_dir, inference_args=None):
         """(ref: base.py:672-696)."""

@@ -217,9 +217,9 @@ class Trainer(BaseTrainer):
         fid = compute_fid(fid_path, self.val_data_loader, extractor,
                           make_gen_fn(self.state["vars_G"]))
         if self.model_average:
-            ema_vars = dict(self.state["vars_G"], params=self.state["ema_G"])
+            self.recalculate_model_average_batch_norm_statistics()
             fid_ema = compute_fid(fid_path, self.val_data_loader, extractor,
-                                  make_gen_fn(ema_vars))
+                                  make_gen_fn(self.inference_params()))
             self._meter("FID_ema").write(float(fid_ema))
         return fid
 
@@ -235,8 +235,10 @@ class Trainer(BaseTrainer):
                data["label"][..., :1],
                out["fake_images"][..., :3]]
         if self.model_average:
-            ema_vars = dict(self.state["vars_G"], params=self.state["ema_G"])
-            ema_out, _ = self._apply_G(ema_vars, data, rng,
+            # the EMA copy's BN stats are re-estimated over training
+            # batches first (ref: trainers/spade.py:189-215, base 415-443)
+            self.recalculate_model_average_batch_norm_statistics()
+            ema_out, _ = self._apply_G(self.inference_params(), data, rng,
                                        training=False, random_style=True)
             vis.append(ema_out["fake_images"][..., :3])
         return vis

@@ -495,6 +495,15 @@ class Trainer(BaseTrainer):
         renderer here, ref: trainers/wc_vid2vid.py:70-87). No-op."""
         pass
 
+    def recalculate_model_average_batch_norm_statistics(self,
+                                                        data_loader=None):
+        """No-op for the video family: the base implementation feeds
+        whole loader batches into _apply_G, which here takes per-frame
+        data_t — and the reference likewise never recalibrates EMA BN
+        stats for its video trainers (only spade/pix2pixHD do,
+        ref: trainers/spade.py:196)."""
+        return
+
     def test(self, data_loader, output_dir, inference_args=None):
         """Frame-by-frame video generation over each test sequence
         (ref: trainers/vid2vid.py:330-417): carry the previous labels

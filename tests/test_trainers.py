@@ -274,3 +274,44 @@ class TestSPADETraining:
         for x, y in zip(a, b):
             np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-6)
         assert trainer2.current_iteration == 1
+
+
+@pytest.mark.slow
+class TestEmaBatchNormRecalibration:
+    def test_recalibrated_stats_differ_and_flow_to_inference(self, rng,
+                                                             tmp_path):
+        """EMA BN stats are re-estimated as the cumulative mean of
+        per-batch statistics (ref: trainers/base.py:415-443,
+        utils/model_average.py:9-33)."""
+        cfg = Config(CFG_PATH)
+        cfg.logdir = str(tmp_path)
+        cfg.trainer.model_average = True
+        cfg.trainer.model_average_start_iteration = 1
+        cfg.trainer.model_average_batch_norm_estimation_iteration = 2
+        cfg.gen.global_adaptive_norm_type = "sync_batch"
+        cfg.gen.activation_norm_params.activation_norm_type = "sync_batch"
+        from imaginaire_tpu.registry import resolve
+
+        batches = [synthetic_batch(rng, h=64, w=64) for _ in range(3)]
+        trainer = resolve(cfg.trainer.type, "Trainer")(
+            cfg, train_data_loader=batches)
+        trainer.init_state(jax.random.PRNGKey(0), batches[0])
+        b = trainer.start_of_iteration(batches[0], 1)
+        trainer.dis_update(b)
+        trainer.gen_update(b)
+        assert trainer.state["vars_G"].get("batch_stats"), \
+            "config change should give the generator BN stats"
+        trainer.recalculate_model_average_batch_norm_statistics()
+        assert trainer._ema_batch_stats is not None
+        live = trainer.state["vars_G"]["batch_stats"]
+        recal = trainer._ema_batch_stats
+        diffs = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))), live, recal)
+        assert max(jax.tree_util.tree_leaves(diffs)) > 1e-6
+        variables = trainer.inference_params()
+        chex_same = jax.tree_util.tree_structure(
+            variables["batch_stats"]) == jax.tree_util.tree_structure(recal)
+        assert chex_same
+        out, _ = trainer._apply_G(variables, trainer._init_data(batches[0]),
+                                  jax.random.PRNGKey(1), training=False)
+        assert np.all(np.isfinite(np.asarray(out["fake_images"])))
