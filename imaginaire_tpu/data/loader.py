@@ -19,7 +19,8 @@ from imaginaire_tpu.registry import resolve
 
 class DataLoader:
     def __init__(self, dataset, batch_size, shuffle=True, seed=0,
-                 drop_last=True, num_workers=0, prefetch_batches=2):
+                 drop_last=True, num_workers=0, prefetch_batches=2,
+                 shard_by_process=True):
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -28,12 +29,17 @@ class DataLoader:
         self.drop_last = drop_last
         self.num_workers = num_workers
         self.prefetch_batches = max(prefetch_batches, 1)
+        # False = every process sees every item, in order — required when
+        # the items are sequential frames of one pinned video sequence
+        # (the video eval harness shards by *sequence* instead)
+        self.shard_by_process = shard_by_process
 
     def set_epoch(self, epoch):
         self.epoch = epoch
 
     def __len__(self):
-        n = len(self.dataset) // get_world_size()
+        shards = get_world_size() if self.shard_by_process else 1
+        n = len(self.dataset) // shards
         if self.drop_last:
             return max(n // self.batch_size, 1)
         return (n + self.batch_size - 1) // self.batch_size
@@ -43,6 +49,8 @@ class DataLoader:
         if self.shuffle:
             rng = np.random.RandomState(self.seed + self.epoch)
             rng.shuffle(order)
+        if not self.shard_by_process:
+            return order
         return order[get_rank()::get_world_size()]
 
     def __iter__(self):

@@ -45,6 +45,20 @@ class Dataset(BaseDataset):
         self.sequence_length = sequence_length
         self._rebuild()
 
+    def num_inference_sequences(self):
+        """(ref: paired_videos.py:91-97)."""
+        assert self.is_inference
+        return len(self.sequences)
+
+    def set_inference_sequence_idx(self, index):
+        """Pin one sequence; items become its frames one by one
+        (ref: paired_videos.py:99-112). The video FID/eval harness and
+        the per-frame test loop iterate this way."""
+        assert self.is_inference
+        self.inference_sequence_idx = index % len(self.sequences)
+        self.epoch_length = len(
+            self.sequences[self.inference_sequence_idx][2])
+
     def _rebuild(self):
         self.valid = [s for s in self.sequences
                       if len(s[2]) >= self.sequence_length]
@@ -54,11 +68,18 @@ class Dataset(BaseDataset):
         return self.epoch_length
 
     def __getitem__(self, index):
-        root_idx, seq, stems = self.valid[index % len(self.valid)]
-        max_start = len(stems) - self.sequence_length
-        start = (0 if self.is_inference
-                 else random.randint(0, max_start) if max_start > 0 else 0)
-        frames = stems[start:start + self.sequence_length]
+        seq_idx = getattr(self, "inference_sequence_idx", None)
+        if self.is_inference and seq_idx is not None:
+            # pinned sequence: item = one frame (ref: paired_videos.py:150+)
+            root_idx, seq, stems = self.sequences[seq_idx]
+            frames = [stems[index % len(stems)]]
+        else:
+            root_idx, seq, stems = self.valid[index % len(self.valid)]
+            max_start = len(stems) - self.sequence_length
+            start = (0 if self.is_inference
+                     else random.randint(0, max_start) if max_start > 0
+                     else 0)
+            frames = stems[start:start + self.sequence_length]
         raw = self.load_item(root_idx, seq, frames)
         out = self.process_item(raw)
         out = self.concat_labels(out)  # keeps (T, H, W, C)

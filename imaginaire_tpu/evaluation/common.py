@@ -83,6 +83,8 @@ def get_video_activations(data_loader, key_real, key_fake, trainer,
         for data in data_loader:
             if trainer is None:
                 images = jnp.asarray(np.asarray(data[key_real]))
+                if images.ndim == 5:  # (B, T=1, H, W, C) frame windows
+                    images = images.reshape((-1,) + images.shape[2:])
             else:
                 out = trainer.test_single(data)
                 images = out["fake_images"]

@@ -77,5 +77,6 @@ def compute_fid(fid_path, data_loader, extractor, generator_fn,
         sample_size=sample_size, max_batches=max_batches)
     mu_real, sigma_real = load_or_compute_stats(
         fid_path, data_loader, key_real, key_fake, extractor,
-        is_video=False, max_batches=max_batches)
+        is_video=is_video, sample_size=sample_size,
+        max_batches=max_batches)
     return calculate_frechet_distance(mu_fake, sigma_fake, mu_real, sigma_real)

@@ -372,6 +372,19 @@ class BaseTrainer:
     def _get_visualizations(self, data):
         return None
 
+    def _fid_extractor(self):
+        """Cached Inception-v3 feature extractor for FID
+        (ref: evaluation/fid.py:16-58); fails loudly without ported
+        weights unless trainer.fid_random_init."""
+        if getattr(self, "_cached_fid_extractor", None) is None:
+            from imaginaire_tpu.evaluation import inception
+
+            variables = inception.load_params(
+                random_init=cfg_get(cfg_get(self.cfg, "trainer", {}),
+                                    "fid_random_init", False))
+            self._cached_fid_extractor = inception.make_extractor(variables)
+        return self._cached_fid_extractor
+
     def _compute_fid(self):
         return None
 
@@ -400,6 +413,15 @@ class BaseTrainer:
         path = ckpt_lib.save_checkpoint(
             logdir, {"state": self.state, "meta": meta},
             current_epoch, current_iteration)
+        # Recalibrated EMA BN stats ride alongside (a sibling file keeps
+        # the state tree's structure stable across checkpoint versions);
+        # the reference persists them inside the averaged model's buffers.
+        if getattr(self, "_ema_batch_stats", None) is not None \
+                and is_master():
+            import pickle
+
+            with open(path + ".ema_bn.pkl", "wb") as f:
+                pickle.dump(jax.device_get(self._ema_batch_stats), f)
         print(f"Save checkpoint to {path}")
         return path
 
@@ -433,6 +455,12 @@ class BaseTrainer:
                 self.state["vars_D"] = restored["vars_D"]
             if "ema_G" in restored:
                 self.state["ema_G"] = restored["ema_G"]
+        bn_path = str(checkpoint_path) + ".ema_bn.pkl"
+        if os.path.exists(bn_path):
+            import pickle
+
+            with open(bn_path, "rb") as f:
+                self._ema_batch_stats = pickle.load(f)
         print(f"Done with loading the checkpoint (resume={bool(resume)}).")
         return True
 

@@ -175,16 +175,6 @@ class Trainer(BaseTrainer):
 
     # ------------------------------------------------------------------ FID
 
-    def _fid_extractor(self):
-        if getattr(self, "_cached_fid_extractor", None) is None:
-            from imaginaire_tpu.evaluation import inception
-
-            variables = inception.load_params(
-                random_init=cfg_get(cfg_get(self.cfg, "trainer", {}),
-                                    "fid_random_init", False))
-            self._cached_fid_extractor = inception.make_extractor(variables)
-        return self._cached_fid_extractor
-
     def _compute_fid(self):
         """FID for the regular and (if enabled) EMA generator
         (ref: trainers/spade.py:264-295)."""

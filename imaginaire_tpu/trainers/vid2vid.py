@@ -504,6 +504,47 @@ class Trainer(BaseTrainer):
         ref: trainers/spade.py:196)."""
         return
 
+    def reset(self):
+        """Reset per-sequence rollout state before generating a new test
+        sequence (ref: trainers/vid2vid.py:298-312). The sequence
+        counter keeps advancing so each sequence draws distinct noise."""
+        self._test_prev_labels = None
+        self._test_prev_images = None
+        self._test_t = 0
+        self._test_seq = getattr(self, "_test_seq", -1) + 1
+
+    def _generate_frame(self, data, t):
+        """Generate frame ``t`` of ``data`` carrying the stored rollout
+        history; advances the history buffers."""
+        data_t = self._get_data_t(data, t,
+                                  getattr(self, "_test_prev_labels", None),
+                                  getattr(self, "_test_prev_images", None))
+        out, _ = self._apply_G(
+            self.inference_params(),
+            {k: v for k, v in data_t.items() if not k.startswith("_")},
+            jax.random.PRNGKey(getattr(self, "_test_seq", 0) * 100003
+                               + getattr(self, "_test_t", 0)),
+            training=False)
+        fake = out["fake_images"]
+        self._after_gen_frame(data_t, fake)
+        self._test_prev_labels = concat_frames(
+            getattr(self, "_test_prev_labels", None), data_t["label"],
+            self.num_frames_G - 1)
+        self._test_prev_images = concat_frames(
+            getattr(self, "_test_prev_images", None), fake,
+            self.num_frames_G - 1)
+        self._test_t = getattr(self, "_test_t", 0) + 1
+        return fake
+
+    def test_single(self, data):
+        """Generate the next frame of the current test sequence — the
+        per-frame entry the video FID/eval harness drives
+        (ref: trainers/vid2vid.py:419-467, evaluation/common.py:79-158).
+        Call reset() at each sequence start."""
+        data = to_device(self._start_of_iteration(
+            numeric_only(dict(data)), -1))
+        return {"fake_images": self._generate_frame(data, 0)}
+
     def test(self, data_loader, output_dir, inference_args=None):
         """Frame-by-frame video generation over each test sequence
         (ref: trainers/vid2vid.py:330-417): carry the previous labels
@@ -517,7 +558,6 @@ class Trainer(BaseTrainer):
         )
 
         os.makedirs(output_dir, exist_ok=True)
-        variables = self.inference_params()
         for it, data in enumerate(data_loader):
             data = self.start_of_iteration(data, current_iteration=-1)
             key = data.get("key", f"{it:06d}")
@@ -526,29 +566,53 @@ class Trainer(BaseTrainer):
             if not isinstance(key, (str, bytes)):
                 key = f"{it:06d}"
             data = numeric_only(data)
+            self.reset()
             self._start_of_test_sequence(data)
             seq_len = (data["images"].shape[1]
                        if data["images"].ndim == 5 else 1)
-            prev_labels = prev_images = None
             for t in range(seq_len):
-                data_t = self._get_data_t(data, t, prev_labels,
-                                          prev_images)
-                out, _ = self._apply_G(
-                    variables, {k: v for k, v in data_t.items()
-                                if not k.startswith("_")},
-                    jax.random.PRNGKey(it * 10007 + t), training=False)
-                fake = out["fake_images"]
-                self._after_gen_frame(data_t, fake)
-                prev_labels = concat_frames(prev_labels, data_t["label"],
-                                            self.num_frames_G - 1)
-                prev_images = concat_frames(prev_images, fake,
-                                            self.num_frames_G - 1)
+                fake = self._generate_frame(data, t)
                 path = os.path.join(output_dir, str(key),
                                     f"{t:04d}.jpg")
                 os.makedirs(os.path.dirname(path), exist_ok=True)
                 save_image_grid(
                     [tensor2im(np.asarray(jax.device_get(fake))[0])],
                     path)
+
+    def _compute_fid(self):
+        """Video FID over generated sequences
+        (ref: trainers/vid2vid.py:697-757): shard the validation
+        sequences, reset + roll out per sequence via test_single, gather
+        Inception activations."""
+        if self.val_data_loader is None:
+            return None
+        import os
+
+        from imaginaire_tpu.evaluation import compute_fid
+
+        try:
+            extractor = self._fid_extractor()
+        except FileNotFoundError as e:
+            print(f"FID skipped: {e}")
+            return None
+        logdir = cfg_get(self.cfg, "logdir", ".")
+        data_name = cfg_get(cfg_get(self.cfg, "data", {}), "name", "data")
+        fid_path = os.path.join(logdir,
+                                f"real_stats_video_{data_name}.npz")
+        sample_size = cfg_get(self.cfg.trainer, "num_videos_to_test", 64)
+        # test_single's contract is strictly sequential frames: a
+        # dedicated batch-1 unsharded loader over the same dataset
+        # (sequences are already sharded per process by the harness;
+        # sharding the pinned sequence's *frames* again would hand each
+        # process every Nth frame).
+        from imaginaire_tpu.data.loader import DataLoader
+
+        frame_loader = DataLoader(self.val_data_loader.dataset,
+                                  batch_size=1, shuffle=False,
+                                  drop_last=False, shard_by_process=False)
+        return float(compute_fid(
+            fid_path, frame_loader, extractor, None,
+            trainer=self, is_video=True, sample_size=sample_size))
 
     def dis_update(self, data):
         """D updates happen inside gen_update's rollout

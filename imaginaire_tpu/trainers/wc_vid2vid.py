@@ -89,6 +89,15 @@ class Trainer(Vid2VidTrainer):
         self.reset_renderer(bool(np.asarray(flipped).any())
                             if flipped is not None else False)
 
+    def reset(self):
+        """(ref: trainers/wc_vid2vid.py:70-87): the per-frame eval
+        harness calls reset() directly — clear the point cloud too.
+        Eval sequences are unflipped; a flip flag left over from the
+        last *training* batch must not leak in (the test() path
+        re-derives it from the data in _start_of_test_sequence)."""
+        super().reset()
+        self.reset_renderer(False)
+
     def _after_gen_frame(self, data_t, fake):
         """Color the point cloud with the freshly generated frame."""
         infos = data_t.get("_point_infos")

@@ -315,3 +315,13 @@ class TestEmaBatchNormRecalibration:
         out, _ = trainer._apply_G(variables, trainer._init_data(batches[0]),
                                   jax.random.PRNGKey(1), training=False)
         assert np.all(np.isfinite(np.asarray(out["fake_images"])))
+        # recalibrated stats survive a checkpoint round-trip
+        trainer.save_checkpoint(0, 1)
+        fresh = resolve(cfg.trainer.type, "Trainer")(
+            cfg, train_data_loader=batches)
+        fresh.init_state(jax.random.PRNGKey(0), batches[0])
+        assert fresh.load_checkpoint()
+        assert getattr(fresh, "_ema_batch_stats", None) is not None
+        np.testing.assert_allclose(
+            np.asarray(jax.tree_util.tree_leaves(fresh._ema_batch_stats)[0]),
+            np.asarray(jax.tree_util.tree_leaves(recal)[0]), rtol=1e-6)
