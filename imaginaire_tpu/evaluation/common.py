@@ -72,9 +72,12 @@ def get_video_activations(data_loader, key_real, key_fake, trainer,
     (ref: common.py:79-158)."""
     dataset = data_loader.dataset
     num_seq = dataset.num_inference_sequences()
-    indices = list(range(num_seq))[jax.process_index()::jax.process_count()]
+    indices = list(range(num_seq))
     if sample_size is not None:
+        # cap the TOTAL video count before sharding, so multi-host runs
+        # evaluate sample_size sequences, not sample_size per process
         indices = indices[:sample_size]
+    indices = indices[jax.process_index()::jax.process_count()]
     acts = []
     for seq_idx in indices:
         dataset.set_inference_sequence_idx(seq_idx)

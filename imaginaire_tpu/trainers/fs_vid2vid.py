@@ -157,6 +157,19 @@ class Trainer(Vid2VidTrainer):
         inference_args.pop("finetune_param_prefixes", None)
         return super().test(data_loader, output_dir, inference_args)
 
+    def _inference_sequence_indices(self, dataset, inference_args):
+        """(ref: trainers/fs_vid2vid.py:146-160): an explicit
+        driving_seq_index tests that single sequence."""
+        if "driving_seq_index" in inference_args:
+            return [int(inference_args["driving_seq_index"])]
+        return super()._inference_sequence_indices(dataset, inference_args)
+
+    def _pin_inference_sequence(self, dataset, seq_idx, inference_args):
+        dataset.set_inference_sequence_idx(
+            seq_idx,
+            inference_args.get("few_shot_seq_index"),
+            inference_args.get("few_shot_frame_index", 0))
+
     def _get_visualizations(self, data):
         """(ref: trainers/fs_vid2vid.py:196-260)."""
         data = to_device(numeric_only(dict(data)))

@@ -546,16 +546,66 @@ class Trainer(BaseTrainer):
         return {"fake_images": self._generate_frame(data, 0)}
 
     def test(self, data_loader, output_dir, inference_args=None):
-        """Frame-by-frame video generation over each test sequence
-        (ref: trainers/vid2vid.py:330-417): carry the previous labels
-        and *generated* frames through the rollout, write one JPEG per
-        frame under <output_dir>/<key>/."""
+        """Frame-by-frame video generation (ref: trainers/vid2vid.py:
+        330-417). With a sequence-pinning dataset, every inference
+        sequence is rolled out frame by frame; direct batch iterables
+        (tests, ad-hoc data) roll out each batch's time axis."""
+        inference_args = dict(inference_args or {})
+        dataset = getattr(data_loader, "dataset", None)
+        if dataset is not None and hasattr(dataset,
+                                           "set_inference_sequence_idx"):
+            return self._test_sequences(dataset, output_dir,
+                                        inference_args)
+        return self._test_batches(data_loader, output_dir)
+
+    def _inference_sequence_indices(self, dataset, inference_args):
+        return range(dataset.num_inference_sequences())
+
+    def _pin_inference_sequence(self, dataset, seq_idx, inference_args):
+        dataset.set_inference_sequence_idx(seq_idx)
+
+    def _save_test_frame(self, output_dir, key, t, fake):
         import os
 
         from imaginaire_tpu.utils.visualization import (
             save_image_grid,
             tensor2im,
         )
+
+        path = os.path.join(output_dir, str(key), f"{t:04d}.jpg")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        save_image_grid(
+            [tensor2im(np.asarray(jax.device_get(fake))[0])], path)
+
+    def _test_sequences(self, dataset, output_dir, inference_args):
+        """(ref: trainers/vid2vid.py:339-417): pin each sequence, build
+        a batch-1 unsharded frame loader, roll out with carried
+        generated history."""
+        import os
+
+        from imaginaire_tpu.data.loader import DataLoader
+
+        os.makedirs(output_dir, exist_ok=True)
+        for seq_idx in self._inference_sequence_indices(dataset,
+                                                        inference_args):
+            self._pin_inference_sequence(dataset, seq_idx, inference_args)
+            frame_loader = DataLoader(dataset, batch_size=1,
+                                      shuffle=False, drop_last=False,
+                                      shard_by_process=False)
+            self.reset()
+            started = False
+            for t, data in enumerate(frame_loader):
+                data = self.start_of_iteration(data, current_iteration=-1)
+                data = numeric_only(data)
+                if not started:
+                    self._start_of_test_sequence(data)
+                    started = True
+                fake = self._generate_frame(data, 0)
+                self._save_test_frame(output_dir, f"seq{seq_idx:04d}", t,
+                                      fake)
+
+    def _test_batches(self, data_loader, output_dir):
+        import os
 
         os.makedirs(output_dir, exist_ok=True)
         for it, data in enumerate(data_loader):
@@ -572,12 +622,7 @@ class Trainer(BaseTrainer):
                        if data["images"].ndim == 5 else 1)
             for t in range(seq_len):
                 fake = self._generate_frame(data, t)
-                path = os.path.join(output_dir, str(key),
-                                    f"{t:04d}.jpg")
-                os.makedirs(os.path.dirname(path), exist_ok=True)
-                save_image_grid(
-                    [tensor2im(np.asarray(jax.device_get(fake))[0])],
-                    path)
+                self._save_test_frame(output_dir, str(key), t, fake)
 
     def _compute_fid(self):
         """Video FID over generated sequences
@@ -585,6 +630,12 @@ class Trainer(BaseTrainer):
         sequences, reset + roll out per sequence via test_single, gather
         Inception activations."""
         if self.val_data_loader is None:
+            return None
+        dataset = getattr(self.val_data_loader, "dataset", None)
+        if dataset is None or not hasattr(dataset,
+                                          "set_inference_sequence_idx"):
+            print("Video FID skipped: val dataset has no sequence "
+                  "pinning (set_inference_sequence_idx).")
             return None
         import os
 

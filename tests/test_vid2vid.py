@@ -224,3 +224,31 @@ class TestVideoFID:
         # cached real stats file written
         import glob
         assert glob.glob(str(tmp_path) + "/real_stats_video_*.npz")
+
+
+@pytest.mark.slow
+class TestVideoInference:
+    def test_test_writes_all_frames_per_sequence(self, tmp_path):
+        """trainer.test over an inference dataset pins each sequence and
+        writes every frame (ref: trainers/vid2vid.py:330-417)."""
+        from imaginaire_tpu.data.loader import DataLoader
+
+        cfg = Config(CFG)
+        cfg.logdir = str(tmp_path)
+        ds = resolve(cfg.data.type, "Dataset")(cfg, is_inference=True)
+        loader = DataLoader(ds, batch_size=1, shuffle=False,
+                            drop_last=False)
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        rng = np.random.RandomState(0)
+        batch = {
+            "images": jnp.asarray(
+                rng.rand(1, 3, 64, 64, 3).astype(np.float32)) * 2 - 1,
+            "label": jnp.asarray(
+                (rng.rand(1, 3, 64, 64, 12) > 0.9).astype(np.float32)),
+        }
+        trainer.init_state(jax.random.PRNGKey(0), batch)
+        out_dir = str(tmp_path / "out")
+        trainer.test(loader, out_dir, None)
+        import glob
+        frames = sorted(glob.glob(out_dir + "/seq0000/*.jpg"))
+        assert len(frames) == 3  # all fixture frames, not just frame 0
