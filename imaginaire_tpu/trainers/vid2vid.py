@@ -552,14 +552,28 @@ class Trainer(BaseTrainer):
         (tests, ad-hoc data) roll out each batch's time axis."""
         inference_args = dict(inference_args or {})
         dataset = getattr(data_loader, "dataset", None)
-        if dataset is not None and hasattr(dataset,
-                                           "set_inference_sequence_idx"):
+        if dataset is not None \
+                and getattr(dataset, "is_inference", False) \
+                and hasattr(dataset, "set_inference_sequence_idx"):
             return self._test_sequences(dataset, output_dir,
                                         inference_args)
         return self._test_batches(data_loader, output_dir)
 
     def _inference_sequence_indices(self, dataset, inference_args):
-        return range(dataset.num_inference_sequences())
+        # sequences shard round-robin per process, mirroring the video
+        # FID harness (evaluation/common.py), so multi-host inference
+        # neither duplicates rollouts nor races on output files
+        return list(range(dataset.num_inference_sequences()))[
+            jax.process_index()::jax.process_count()]
+
+    def _frame_loader(self, dataset):
+        """Batch-1 unsharded loader over a pinned sequence's frames —
+        the strictly-sequential contract test_single/_generate_frame
+        require (frames of one sequence must never rank-shard)."""
+        from imaginaire_tpu.data.loader import DataLoader
+
+        return DataLoader(dataset, batch_size=1, shuffle=False,
+                          drop_last=False, shard_by_process=False)
 
     def _pin_inference_sequence(self, dataset, seq_idx, inference_args):
         dataset.set_inference_sequence_idx(seq_idx)
@@ -583,15 +597,11 @@ class Trainer(BaseTrainer):
         generated history."""
         import os
 
-        from imaginaire_tpu.data.loader import DataLoader
-
         os.makedirs(output_dir, exist_ok=True)
+        frame_loader = self._frame_loader(dataset)
         for seq_idx in self._inference_sequence_indices(dataset,
                                                         inference_args):
             self._pin_inference_sequence(dataset, seq_idx, inference_args)
-            frame_loader = DataLoader(dataset, batch_size=1,
-                                      shuffle=False, drop_last=False,
-                                      shard_by_process=False)
             self.reset()
             started = False
             for t, data in enumerate(frame_loader):
@@ -651,18 +661,8 @@ class Trainer(BaseTrainer):
         fid_path = os.path.join(logdir,
                                 f"real_stats_video_{data_name}.npz")
         sample_size = cfg_get(self.cfg.trainer, "num_videos_to_test", 64)
-        # test_single's contract is strictly sequential frames: a
-        # dedicated batch-1 unsharded loader over the same dataset
-        # (sequences are already sharded per process by the harness;
-        # sharding the pinned sequence's *frames* again would hand each
-        # process every Nth frame).
-        from imaginaire_tpu.data.loader import DataLoader
-
-        frame_loader = DataLoader(self.val_data_loader.dataset,
-                                  batch_size=1, shuffle=False,
-                                  drop_last=False, shard_by_process=False)
         return float(compute_fid(
-            fid_path, frame_loader, extractor, None,
+            fid_path, self._frame_loader(dataset), extractor, None,
             trainer=self, is_video=True, sample_size=sample_size))
 
     def dis_update(self, data):

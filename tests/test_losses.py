@@ -266,3 +266,147 @@ class TestPerceptualBackbones:
         ref_keys = jax.tree_util.tree_structure(ref["params"])
         loaded_keys = jax.tree_util.tree_structure(loaded)
         assert ref_keys == loaded_keys
+
+
+class TestVGGGoldenVsTorch:
+    def test_vgg19_features_match_torch(self, rng, tmp_path):
+        """Numerical golden test: the torchvision-layout VGG19 feature
+        stack (built in torch with random weights), dumped in state-dict
+        form and loaded through load_torch_vgg_weights, produces the
+        same activations as our Flax VGGFeatures on the same input
+        (ref: perceptual.py:175-208 semantics)."""
+        import torch
+        import torch.nn as tnn
+
+        from imaginaire_tpu.losses.perceptual import (
+            _VGG19_CFG,
+            VGGFeatures,
+            load_torch_vgg_weights,
+        )
+
+        layers, in_ch = [], 3
+        for v in _VGG19_CFG:
+            if v == "M":
+                layers.append(tnn.MaxPool2d(2, 2))
+            else:
+                layers.append(tnn.Conv2d(in_ch, v, 3, padding=1))
+                layers.append(tnn.ReLU(inplace=False))
+                in_ch = v
+        torch.manual_seed(0)
+        features = tnn.Sequential(*layers).eval()
+
+        npz = {f"features.{i}.{p}": t.detach().numpy()
+               for i, m in enumerate(features)
+               if isinstance(m, tnn.Conv2d)
+               for p, t in (("weight", m.weight), ("bias", m.bias))}
+        path = str(tmp_path / "vgg19.npz")
+        np.savez(path, **npz)
+
+        capture = ("relu_1_1", "relu_2_1", "relu_3_1", "relu_4_1",
+                   "relu_5_1")
+        params = load_torch_vgg_weights(path, "vgg19")
+        module = VGGFeatures(capture=capture)
+
+        x = rng.rand(2, 64, 64, 3).astype(np.float32)
+        ours = module.apply({"params": params}, jnp.asarray(x))
+        with torch.no_grad():
+            t = torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))
+            idx_of = {}
+            block, bidx = 1, 1
+            for i, m in enumerate(features):
+                if isinstance(m, tnn.MaxPool2d):
+                    block += 1
+                    bidx = 1
+                elif isinstance(m, tnn.ReLU):
+                    idx_of[f"relu_{block}_{bidx}"] = i
+                    bidx += 1
+            acts = {}
+            h = t
+            for i, m in enumerate(features):
+                h = m(h)
+                for name, j in idx_of.items():
+                    if j == i and name in capture:
+                        acts[name] = h.numpy()
+        for name in capture:
+            theirs = np.transpose(acts[name], (0, 2, 3, 1))
+            np.testing.assert_allclose(np.asarray(ours[name]), theirs,
+                                       rtol=2e-4, atol=2e-5,
+                                       err_msg=name)
+
+    def test_vgg16_features_match_torch(self, rng, tmp_path):
+        """Same golden check for the VGG16 configuration."""
+        import torch
+        import torch.nn as tnn
+
+        from imaginaire_tpu.losses.perceptual import (
+            VGGFeatures,
+            _VGG16_CFG,
+            load_torch_vgg_weights,
+        )
+
+        layers, in_ch = [], 3
+        for v in _VGG16_CFG:
+            if v == "M":
+                layers.append(tnn.MaxPool2d(2, 2))
+            else:
+                layers.append(tnn.Conv2d(in_ch, v, 3, padding=1))
+                layers.append(tnn.ReLU(inplace=False))
+                in_ch = v
+        torch.manual_seed(1)
+        features = tnn.Sequential(*layers).eval()
+        npz = {f"features.{i}.{p}": t.detach().numpy()
+               for i, m in enumerate(features)
+               if isinstance(m, tnn.Conv2d)
+               for p, t in (("weight", m.weight), ("bias", m.bias))}
+        path = str(tmp_path / "vgg16.npz")
+        np.savez(path, **npz)
+        params = load_torch_vgg_weights(path, "vgg16")
+        module = VGGFeatures(cfg=_VGG16_CFG, capture=("relu_3_1",))
+        x = rng.rand(1, 64, 64, 3).astype(np.float32)
+        ours = module.apply({"params": params}, jnp.asarray(x))
+        with torch.no_grad():
+            h = torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))
+            # relu_3_1 = first conv+relu of block 3 -> Sequential idx 11
+            for m in features[:12]:
+                h = m(h)
+        np.testing.assert_allclose(
+            np.asarray(ours["relu_3_1"]),
+            np.transpose(h.numpy(), (0, 2, 3, 1)), rtol=2e-4, atol=2e-5)
+
+    def test_alexnet_features_match_torch(self, rng, tmp_path):
+        """Golden check for the AlexNet port (torchvision Sequential
+        layout: convs at 0,3,6,8,10)."""
+        import torch
+        import torch.nn as tnn
+
+        from imaginaire_tpu.losses.perceptual import (
+            AlexNetFeatures,
+            load_torch_alexnet_weights,
+        )
+
+        torch.manual_seed(2)
+        features = tnn.Sequential(
+            tnn.Conv2d(3, 64, 11, stride=4, padding=2), tnn.ReLU(),
+            tnn.MaxPool2d(3, 2),
+            tnn.Conv2d(64, 192, 5, padding=2), tnn.ReLU(),
+            tnn.MaxPool2d(3, 2),
+            tnn.Conv2d(192, 384, 3, padding=1), tnn.ReLU(),
+            tnn.Conv2d(384, 256, 3, padding=1), tnn.ReLU(),
+            tnn.Conv2d(256, 256, 3, padding=1), tnn.ReLU(),
+        ).eval()
+        npz = {f"features.{i}.{p}": t.detach().numpy()
+               for i, m in enumerate(features)
+               if isinstance(m, tnn.Conv2d)
+               for p, t in (("weight", m.weight), ("bias", m.bias))}
+        path = str(tmp_path / "alexnet.npz")
+        np.savez(path, **npz)
+        params = load_torch_alexnet_weights(path)
+        module = AlexNetFeatures(capture=("relu_5",))
+        x = rng.rand(1, 96, 96, 3).astype(np.float32)
+        ours = module.apply({"params": params}, jnp.asarray(x))
+        with torch.no_grad():
+            h = torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))
+            h = features(h)
+        np.testing.assert_allclose(
+            np.asarray(ours["relu_5"]),
+            np.transpose(h.numpy(), (0, 2, 3, 1)), rtol=2e-4, atol=2e-5)
