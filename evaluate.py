@@ -34,6 +34,10 @@ def parse_args():
     parser.add_argument("--checkpoint", default=None,
                         help="Evaluate one specific checkpoint.")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--metrics", default="fid",
+                        help="Comma list of metrics: fid[,kid,prdc] "
+                             "(the reference's sweep computes FID only; "
+                             "kid/prdc are this framework's additions).")
     return parser.parse_args()
 
 
@@ -65,11 +69,25 @@ def main():
     else:
         raise SystemExit("pass --checkpoint or --checkpoint_logdir")
 
+    metrics = [m.strip().lower() for m in args.metrics.split(",")
+               if m.strip()]
+    unknown = set(metrics) - {"fid", "kid", "prdc"}
+    if unknown:
+        raise SystemExit(f"unknown --metrics {sorted(unknown)}; "
+                         "supported: fid, kid, prdc")
     for checkpoint in checkpoints:
         trainer.load_checkpoint(checkpoint, resume=True)
         print(f"Evaluating {checkpoint} (epoch {trainer.current_epoch}, "
               f"iteration {trainer.current_iteration})")
-        trainer.write_metrics()
+        if "fid" in metrics:
+            trainer.write_metrics()
+        extra_requested = [m for m in metrics if m != "fid"]
+        extra = trainer.compute_extra_metrics(extra_requested)
+        if extra_requested and not extra:
+            print(f"  note: {type(trainer).__module__} computes no extra "
+                  f"metrics for {extra_requested}")
+        for name, value in extra.items():
+            print(f"  {name}: {value:.5f}")
     print("Done with evaluation!!!")
 
 

@@ -28,6 +28,10 @@ def _kth_nn_radius(x, k):
 
 
 def prdc_from_activations(act_real, act_fake, nearest_k=5):
+    # a set of n points has at most n-1 neighbors: clamp k so tiny
+    # validation sets (unit-test fixtures) evaluate instead of crashing
+    nearest_k = max(1, min(nearest_k,
+                           act_real.shape[0] - 1, act_fake.shape[0] - 1))
     radii_real = _kth_nn_radius(act_real, nearest_k)
     radii_fake = _kth_nn_radius(act_fake, nearest_k)
     d_rf = _pairwise_distances(act_real, act_fake)  # (Nr, Nf)

@@ -388,6 +388,11 @@ class BaseTrainer:
     def _compute_fid(self):
         return None
 
+    def compute_extra_metrics(self, metrics):
+        """Optional extra eval metrics ('kid', 'prdc') -> {name: value}.
+        Image trainer families implement this; default none."""
+        return {}
+
     def write_metrics(self):
         """FID + best-FID tracking (ref: base.py:467-479)."""
         fid = self._compute_fid()

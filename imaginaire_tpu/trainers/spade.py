@@ -175,6 +175,56 @@ class Trainer(BaseTrainer):
 
     # ------------------------------------------------------------------ FID
 
+    def _make_eval_gen_fn(self, variables):
+        """Validation-set generator closure shared by FID/KID/PRDC.
+        Uses the side-effect-free _start_of_iteration (the full hook
+        would clobber current_iteration/timers mid-metrics)."""
+        def gen_fn(data):
+            data = to_device(self._start_of_iteration(data, -1))
+            out, _ = self._apply_G(variables, data, jax.random.PRNGKey(0),
+                                   training=False)
+            return out["fake_images"]
+        return gen_fn
+
+    def compute_extra_metrics(self, metrics):
+        """KID / PRDC over the validation set — metrics the reference
+        ships as library code (evaluation/kid.py, prdc.py) but never
+        wires into its evaluate sweep; here evaluate.py --metrics does.
+        One (real, fake) activation pass feeds both metrics."""
+        out = {}
+        metrics = {str(m).lower() for m in (metrics or ())}
+        unknown = metrics - {"kid", "prdc"}
+        if unknown:
+            print(f"Unknown extra metrics ignored: {sorted(unknown)}")
+        metrics &= {"kid", "prdc"}
+        if not metrics or self.val_data_loader is None:
+            return out
+        try:
+            extractor = self._fid_extractor()
+        except FileNotFoundError as e:
+            print(f"extra metrics skipped: {e}")
+            return out
+
+        from imaginaire_tpu.evaluation.common import get_activations
+        from imaginaire_tpu.evaluation.kid import kid_from_activations
+        from imaginaire_tpu.evaluation.prdc import prdc_from_activations
+
+        gen_fn = self._make_eval_gen_fn(self.inference_params())
+        act_fake = get_activations(self.val_data_loader, "images",
+                                   "fake_images", extractor,
+                                   generator_fn=gen_fn)
+        act_real = get_activations(self.val_data_loader, "images",
+                                   "fake_images", extractor)
+        if "kid" in metrics:
+            out["KID"] = float(kid_from_activations(act_real, act_fake))
+        if "prdc" in metrics:
+            prdc = prdc_from_activations(act_real, act_fake)
+            out.update({f"PRDC_{k}": float(v) for k, v in prdc.items()})
+        for name, value in out.items():
+            self._meter(name).write(value)
+        self._flush_meters(self.current_iteration)
+        return out
+
     def _compute_fid(self):
         """FID for the regular and (if enabled) EMA generator
         (ref: trainers/spade.py:264-295)."""
@@ -194,22 +244,13 @@ class Trainer(BaseTrainer):
         data_name = cfg_get(cfg_get(self.cfg, "data", {}), "name", "data")
         fid_path = os.path.join(logdir, f"real_stats_{data_name}.npz")
 
-        def make_gen_fn(variables):
-            def gen_fn(data):
-                # side-effect-free preprocessing (start_of_iteration would
-                # clobber current_iteration/timers mid-write_metrics)
-                data = to_device(self._start_of_iteration(data, -1))
-                out, _ = self._apply_G(variables, data, jax.random.PRNGKey(0),
-                                       training=False)
-                return out["fake_images"]
-            return gen_fn
-
         fid = compute_fid(fid_path, self.val_data_loader, extractor,
-                          make_gen_fn(self.state["vars_G"]))
+                          self._make_eval_gen_fn(self.state["vars_G"]))
         if self.model_average:
             self.recalculate_model_average_batch_norm_statistics()
-            fid_ema = compute_fid(fid_path, self.val_data_loader, extractor,
-                                  make_gen_fn(self.inference_params()))
+            fid_ema = compute_fid(
+                fid_path, self.val_data_loader, extractor,
+                self._make_eval_gen_fn(self.inference_params()))
             self._meter("FID_ema").write(float(fid_ema))
         return fid
 
