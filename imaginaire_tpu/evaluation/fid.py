@@ -16,6 +16,13 @@ from imaginaire_tpu.evaluation.common import get_activations, get_video_activati
 from imaginaire_tpu.parallel.mesh import is_master, master_only_print as print  # noqa: A001
 
 
+# Version of the inception feature graph the cached stats were computed
+# with. Bump whenever the extractor's numerics change (e.g. the
+# count_include_pad fix) so stale caches are recomputed, not silently
+# mixed with features from a different graph.
+FEATURE_GRAPH_VERSION = 2
+
+
 def activation_stats(acts):
     mu = np.mean(acts, axis=0)
     sigma = np.cov(acts, rowvar=False)
@@ -49,7 +56,11 @@ def load_or_compute_stats(path, data_loader, key_real, key_fake, extractor,
     recomputed; real stats load from ``path`` when present."""
     if path and os.path.exists(path) and generator_fn is None and trainer is None:
         npz = np.load(path)
-        return npz["mu"], npz["sigma"]
+        if int(npz.get("graph_version", 0)) == FEATURE_GRAPH_VERSION:
+            return npz["mu"], npz["sigma"]
+        print(f"FID: stale real-stat cache at {path} (feature graph "
+              f"v{int(npz.get('graph_version', 0))} != "
+              f"v{FEATURE_GRAPH_VERSION}), recomputing")
     if is_video:
         acts = get_video_activations(data_loader, key_real, key_fake,
                                      trainer, extractor, sample_size)
@@ -60,7 +71,8 @@ def load_or_compute_stats(path, data_loader, key_real, key_fake, extractor,
     mu, sigma = activation_stats(acts)
     if path and generator_fn is None and trainer is None and is_master():
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        np.savez(path, mu=mu, sigma=sigma)
+        np.savez(path, mu=mu, sigma=sigma,
+                 graph_version=FEATURE_GRAPH_VERSION)
         print(f"FID: cached real stats to {path}")
     return mu, sigma
 

@@ -52,8 +52,12 @@ class BasicConv(nn.Module):
 
 
 def _avg_pool3(x):
+    # torchvision branch_pool is F.avg_pool2d(x, 3, stride=1, padding=1)
+    # whose count_include_pad defaults to True (the reference feeds the
+    # unpatched torchvision graph, ref: evaluation/common.py:32-37 — NOT
+    # the pytorch-fid variant that divides by the unpadded window).
     return nn.avg_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)),
-                       count_include_pad=False)
+                       count_include_pad=True)
 
 
 def _max_pool3s2(x):

@@ -47,6 +47,15 @@ def convert_inception(out_path):
     net = torchvision.models.inception_v3(
         pretrained=True, transform_input=False, aux_logits=True).eval()
     sd = {k: v.detach().cpu().numpy() for k, v in net.state_dict().items()}
+    flat = inception_state_to_npz(sd)
+    np.savez(out_path, **flat)
+    print(f"wrote {len(flat)} arrays to {out_path}")
+
+
+def inception_state_to_npz(sd):
+    """torchvision inception_v3 state-dict arrays -> flat flax-path dict
+    (shared by convert_inception and the golden test, which feeds a
+    hand-built torch graph through the same mapping)."""
     flat = {}
     for k, v in sd.items():
         if k.startswith("AuxLogits.") or k.startswith("fc."):
@@ -64,8 +73,7 @@ def convert_inception(out_path):
             flat["/".join(parts[:-2] + [suffix])] = v
         else:
             raise ValueError(f"unexpected key {k}")
-    np.savez(out_path, **flat)
-    print(f"wrote {len(flat)} arrays to {out_path}")
+    return flat
 
 
 def convert_resnet50(out_path, robust_ckpt=None):

@@ -1,0 +1,613 @@
+"""Activation-level numerical goldens for the Inception-v3 and FlowNet2
+ports against hand-built torch graphs (the same recipe as the VGG19
+golden in test_losses.py).
+
+The torch side is constructed in-test from the reference specs —
+torchvision's ``inception_v3`` graph (what the reference feeds for FID,
+ref: imaginaire/evaluation/common.py:32-37) and the vendored FlowNet2
+(ref: imaginaire/third_party/flow_net/flownet2/models.py:20-173,
+networks/*.py) — with random weights. The weights travel through the
+real offline converters (scripts/convert_weights.py) into the Flax
+models, and activations are compared at several taps including post-BN
+and post-pool. A transposed kernel, wrong BN eps, wrong pooling padding
+or wrong upsample convention in either port fails here.
+"""
+
+import os
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+from torch import nn as tnn
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+import convert_weights  # noqa: E402
+
+
+def _randomize_bn(module, seed):
+    """Random BN affines + running stats (var positive); conv weights keep
+    torch's default (already random) init, which both sides share via the
+    converter."""
+    g = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for name, p in module.state_dict().items():
+            if name.endswith("running_var"):
+                p.copy_(0.5 + torch.rand(p.shape, generator=g))
+            elif name.endswith("running_mean"):
+                p.copy_(0.3 * torch.randn(p.shape, generator=g))
+            elif name.endswith("bn.weight"):
+                p.copy_(1.0 + 0.2 * torch.randn(p.shape, generator=g))
+            elif name.endswith("bn.bias"):
+                p.copy_(0.1 * torch.randn(p.shape, generator=g))
+
+
+def _nhwc(t):
+    return np.transpose(t.detach().numpy(), (0, 2, 3, 1))
+
+
+# ---------------------------------------------------------------------------
+# Inception-v3 (torchvision graph, hand-built; ref: evaluation/fid.py:60-100)
+# ---------------------------------------------------------------------------
+
+
+class TBasicConv(tnn.Module):
+    def __init__(self, i, o, **kw):
+        super().__init__()
+        self.conv = tnn.Conv2d(i, o, bias=False, **kw)
+        self.bn = tnn.BatchNorm2d(o, eps=0.001)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class TInceptionA(tnn.Module):
+    def __init__(self, i, pool_features):
+        super().__init__()
+        self.branch1x1 = TBasicConv(i, 64, kernel_size=1)
+        self.branch5x5_1 = TBasicConv(i, 48, kernel_size=1)
+        self.branch5x5_2 = TBasicConv(48, 64, kernel_size=5, padding=2)
+        self.branch3x3dbl_1 = TBasicConv(i, 64, kernel_size=1)
+        self.branch3x3dbl_2 = TBasicConv(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = TBasicConv(96, 96, kernel_size=3, padding=1)
+        self.branch_pool = TBasicConv(i, pool_features, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b5 = self.branch5x5_2(self.branch5x5_1(x))
+        b3 = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        bp = self.branch_pool(F.avg_pool2d(x, 3, stride=1, padding=1))
+        return torch.cat([b1, b5, b3, bp], 1)
+
+
+class TInceptionB(tnn.Module):
+    def __init__(self, i):
+        super().__init__()
+        self.branch3x3 = TBasicConv(i, 384, kernel_size=3, stride=2)
+        self.branch3x3dbl_1 = TBasicConv(i, 64, kernel_size=1)
+        self.branch3x3dbl_2 = TBasicConv(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = TBasicConv(96, 96, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b3 = self.branch3x3(x)
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        return torch.cat([b3, bd, F.max_pool2d(x, 3, stride=2)], 1)
+
+
+class TInceptionC(tnn.Module):
+    def __init__(self, i, c7):
+        super().__init__()
+        self.branch1x1 = TBasicConv(i, 192, kernel_size=1)
+        self.branch7x7_1 = TBasicConv(i, c7, kernel_size=1)
+        self.branch7x7_2 = TBasicConv(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7_3 = TBasicConv(c7, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_1 = TBasicConv(i, c7, kernel_size=1)
+        self.branch7x7dbl_2 = TBasicConv(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_3 = TBasicConv(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7dbl_4 = TBasicConv(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_5 = TBasicConv(c7, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch_pool = TBasicConv(i, 192, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        bd = self.branch7x7dbl_5(self.branch7x7dbl_4(self.branch7x7dbl_3(
+            self.branch7x7dbl_2(self.branch7x7dbl_1(x)))))
+        bp = self.branch_pool(F.avg_pool2d(x, 3, stride=1, padding=1))
+        return torch.cat([b1, b7, bd, bp], 1)
+
+
+class TInceptionD(tnn.Module):
+    def __init__(self, i):
+        super().__init__()
+        self.branch3x3_1 = TBasicConv(i, 192, kernel_size=1)
+        self.branch3x3_2 = TBasicConv(192, 320, kernel_size=3, stride=2)
+        self.branch7x7x3_1 = TBasicConv(i, 192, kernel_size=1)
+        self.branch7x7x3_2 = TBasicConv(192, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7x3_3 = TBasicConv(192, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7x3_4 = TBasicConv(192, 192, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b3 = self.branch3x3_2(self.branch3x3_1(x))
+        b7 = self.branch7x7x3_4(self.branch7x7x3_3(self.branch7x7x3_2(
+            self.branch7x7x3_1(x))))
+        return torch.cat([b3, b7, F.max_pool2d(x, 3, stride=2)], 1)
+
+
+class TInceptionE(tnn.Module):
+    def __init__(self, i):
+        super().__init__()
+        self.branch1x1 = TBasicConv(i, 320, kernel_size=1)
+        self.branch3x3_1 = TBasicConv(i, 384, kernel_size=1)
+        self.branch3x3_2a = TBasicConv(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3_2b = TBasicConv(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = TBasicConv(i, 448, kernel_size=1)
+        self.branch3x3dbl_2 = TBasicConv(448, 384, kernel_size=3, padding=1)
+        self.branch3x3dbl_3a = TBasicConv(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = TBasicConv(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch_pool = TBasicConv(i, 192, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b3 = self.branch3x3_1(x)
+        b3 = torch.cat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], 1)
+        bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        bd = torch.cat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)], 1)
+        bp = self.branch_pool(F.avg_pool2d(x, 3, stride=1, padding=1))
+        return torch.cat([b1, b3, bd, bp], 1)
+
+
+class TInceptionV3(tnn.Module):
+    def __init__(self):
+        super().__init__()
+        self.Conv2d_1a_3x3 = TBasicConv(3, 32, kernel_size=3, stride=2)
+        self.Conv2d_2a_3x3 = TBasicConv(32, 32, kernel_size=3)
+        self.Conv2d_2b_3x3 = TBasicConv(32, 64, kernel_size=3, padding=1)
+        self.Conv2d_3b_1x1 = TBasicConv(64, 80, kernel_size=1)
+        self.Conv2d_4a_3x3 = TBasicConv(80, 192, kernel_size=3)
+        self.Mixed_5b = TInceptionA(192, 32)
+        self.Mixed_5c = TInceptionA(256, 64)
+        self.Mixed_5d = TInceptionA(288, 64)
+        self.Mixed_6a = TInceptionB(288)
+        self.Mixed_6b = TInceptionC(768, 128)
+        self.Mixed_6c = TInceptionC(768, 160)
+        self.Mixed_6d = TInceptionC(768, 160)
+        self.Mixed_6e = TInceptionC(768, 192)
+        self.Mixed_7a = TInceptionD(768)
+        self.Mixed_7b = TInceptionE(1280)
+        self.Mixed_7c = TInceptionE(2048)
+
+    def forward(self, x):
+        taps = {}
+        x = taps["Conv2d_1a_3x3"] = self.Conv2d_1a_3x3(x)
+        x = self.Conv2d_2a_3x3(x)
+        x = taps["Conv2d_2b_3x3"] = self.Conv2d_2b_3x3(x)
+        x = F.max_pool2d(x, 3, stride=2)
+        x = self.Conv2d_3b_1x1(x)
+        x = self.Conv2d_4a_3x3(x)
+        x = F.max_pool2d(x, 3, stride=2)
+        x = taps["Mixed_5b"] = self.Mixed_5b(x)
+        x = self.Mixed_5c(x)
+        x = self.Mixed_5d(x)
+        x = taps["Mixed_6a"] = self.Mixed_6a(x)
+        x = self.Mixed_6b(x)
+        x = self.Mixed_6c(x)
+        x = self.Mixed_6d(x)
+        x = taps["Mixed_6e"] = self.Mixed_6e(x)
+        x = self.Mixed_7a(x)
+        x = self.Mixed_7b(x)
+        x = taps["Mixed_7c"] = self.Mixed_7c(x)
+        taps["pool"] = x.mean(dim=(2, 3))
+        return taps
+
+
+@pytest.mark.slow
+class TestInceptionGoldenVsTorch:
+    def test_activations_match(self, tmp_path):
+        from imaginaire_tpu.evaluation.inception import InceptionV3, load_params
+
+        torch.manual_seed(0)
+        tnet = TInceptionV3().eval()
+        _randomize_bn(tnet, seed=0)
+        sd = {k: v.numpy() for k, v in tnet.state_dict().items()}
+        flat = convert_weights.inception_state_to_npz(sd)
+        path = str(tmp_path / "inception_v3.npz")
+        np.savez(path, **flat)
+        variables = load_params(path)
+
+        x = np.random.RandomState(0).rand(2, 128, 128, 3).astype(np.float32)
+        x = x * 2.0 - 1.0
+        feats, state = InceptionV3().apply(
+            variables, jnp.asarray(x), capture_intermediates=True,
+            mutable=["intermediates"])
+        inter = state["intermediates"]
+
+        with torch.no_grad():
+            taps = tnet(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))))
+
+        for name in ("Conv2d_1a_3x3", "Conv2d_2b_3x3", "Mixed_5b",
+                     "Mixed_6a", "Mixed_6e", "Mixed_7c"):
+            ours = np.asarray(inter[name]["__call__"][0])
+            theirs = _nhwc(taps[name])
+            np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4,
+                                       err_msg=name)
+        np.testing.assert_allclose(np.asarray(feats),
+                                   taps["pool"].numpy(),
+                                   rtol=1e-4, atol=1e-4, err_msg="pool")
+
+    def test_float64_exact_at_299(self, tmp_path):
+        """f64 at the real FID input size: both graphs agree to ~1e-12,
+        proving the ports are semantically identical (fp32 divergence in
+        the random-stat net is pure precision amplification)."""
+        import jax
+
+        from imaginaire_tpu.evaluation.inception import InceptionV3
+
+        torch.manual_seed(7)
+        tnet = TInceptionV3().eval().double()
+        _randomize_bn(tnet, seed=7)
+        sd = {k: v.numpy() for k, v in tnet.state_dict().items()}
+        flat = convert_weights.inception_state_to_npz(sd)
+
+        jax.config.update("jax_enable_x64", True)
+        try:
+            params = {}
+            for k, v in flat.items():
+                node = params
+                parts = k.split("/")
+                for p in parts[:-1]:
+                    node = node.setdefault(p, {})
+                node[parts[-1]] = jnp.asarray(v, jnp.float64)
+            x = np.random.RandomState(3).rand(1, 299, 299, 3) * 2 - 1
+            ours = np.asarray(InceptionV3().apply({"params": params},
+                                                  jnp.asarray(x)))
+        finally:
+            jax.config.update("jax_enable_x64", False)
+        with torch.no_grad():
+            theirs = tnet(torch.from_numpy(
+                np.transpose(x, (0, 3, 1, 2))))["pool"].numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-10, atol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# FlowNet2 (flownet2-pytorch graph, hand-built;
+# ref: third_party/flow_net/flownet2/networks/*.py, models.py:20-173)
+# ---------------------------------------------------------------------------
+
+
+def t_conv(i, o, k=3, s=1):
+    return tnn.Sequential(tnn.Conv2d(i, o, k, s, (k - 1) // 2, bias=True),
+                          tnn.LeakyReLU(0.1))
+
+
+def t_iconv(i, o):
+    return tnn.Sequential(tnn.Conv2d(i, o, 3, 1, 1, bias=True))
+
+
+def t_deconv(i, o):
+    return tnn.Sequential(tnn.ConvTranspose2d(i, o, 4, 2, 1, bias=True),
+                          tnn.LeakyReLU(0.1))
+
+
+def t_predict(i):
+    return tnn.Conv2d(i, 2, 3, 1, 1, bias=True)
+
+
+def t_correlation(a, b, pad=20, max_disp=20, stride2=2):
+    """Independent cost volume: mean over channels of shifted products,
+    row-major (dy, dx) grid (ref: correlation_cuda_kernel.cu)."""
+    bsz, c, h, w = a.shape
+    bp = F.pad(b, (pad, pad, pad, pad))
+    outs = []
+    for dy in range(-max_disp, max_disp + 1, stride2):
+        for dx in range(-max_disp, max_disp + 1, stride2):
+            shifted = bp[:, :, pad + dy:pad + dy + h, pad + dx:pad + dx + w]
+            outs.append((a * shifted).mean(dim=1, keepdim=True))
+    return torch.cat(outs, 1)
+
+
+def t_resample(x, flow):
+    """Independent bilinear warp with the CUDA op's clamp-after-weighting
+    border handling (ref: resample2d_kernel.cu:16-75)."""
+    bsz, c, h, w = x.shape
+    xs = torch.arange(w, dtype=torch.float32).view(1, 1, w) + flow[:, 0]
+    ys = torch.arange(h, dtype=torch.float32).view(1, h, 1) + flow[:, 1]
+    x0 = torch.floor(xs)
+    y0 = torch.floor(ys)
+    ax = (xs - x0).unsqueeze(1)
+    ay = (ys - y0).unsqueeze(1)
+    x0i = x0.long().clamp(0, w - 1)
+    x1i = (x0.long() + 1).clamp(0, w - 1)
+    y0i = y0.long().clamp(0, h - 1)
+    y1i = (y0.long() + 1).clamp(0, h - 1)
+
+    def g(yi, xi):
+        idx = (yi * w + xi).view(bsz, 1, -1).expand(bsz, c, h * w)
+        return x.reshape(bsz, c, -1).gather(2, idx).view(bsz, c, h, w)
+
+    return ((1 - ay) * (1 - ax) * g(y0i, x0i) + (1 - ay) * ax * g(y0i, x1i)
+            + ay * (1 - ax) * g(y1i, x0i) + ay * ax * g(y1i, x1i))
+
+
+def t_channelnorm(x):
+    return x.pow(2).sum(dim=1, keepdim=True).sqrt()
+
+
+class TFlowNetC(tnn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = t_conv(3, 64, 7, 2)
+        self.conv2 = t_conv(64, 128, 5, 2)
+        self.conv3 = t_conv(128, 256, 5, 2)
+        self.conv_redir = t_conv(256, 32, 1, 1)
+        self.conv3_1 = t_conv(473, 256)
+        self.conv4 = t_conv(256, 512, s=2)
+        self.conv4_1 = t_conv(512, 512)
+        self.conv5 = t_conv(512, 512, s=2)
+        self.conv5_1 = t_conv(512, 512)
+        self.conv6 = t_conv(512, 1024, s=2)
+        self.conv6_1 = t_conv(1024, 1024)
+        self.deconv5 = t_deconv(1024, 512)
+        self.deconv4 = t_deconv(1026, 256)
+        self.deconv3 = t_deconv(770, 128)
+        self.deconv2 = t_deconv(386, 64)
+        self.predict_flow6 = t_predict(1024)
+        self.predict_flow5 = t_predict(1026)
+        self.predict_flow4 = t_predict(770)
+        self.predict_flow3 = t_predict(386)
+        self.predict_flow2 = t_predict(194)
+        self.upsampled_flow6_to_5 = tnn.ConvTranspose2d(2, 2, 4, 2, 1, bias=True)
+        self.upsampled_flow5_to_4 = tnn.ConvTranspose2d(2, 2, 4, 2, 1, bias=True)
+        self.upsampled_flow4_to_3 = tnn.ConvTranspose2d(2, 2, 4, 2, 1, bias=True)
+        self.upsampled_flow3_to_2 = tnn.ConvTranspose2d(2, 2, 4, 2, 1, bias=True)
+
+    def forward(self, x):
+        x1, x2 = x[:, :3], x[:, 3:]
+        c1a = self.conv1(x1)
+        c2a = self.conv2(c1a)
+        c3a = self.conv3(c2a)
+        c3b = self.conv3(self.conv2(self.conv1(x2)))
+        corr = F.leaky_relu(t_correlation(c3a, c3b), 0.1)
+        x = torch.cat([self.conv_redir(c3a), corr], 1)
+        c31 = self.conv3_1(x)
+        c4 = self.conv4_1(self.conv4(c31))
+        c5 = self.conv5_1(self.conv5(c4))
+        c6 = self.conv6_1(self.conv6(c5))
+        flow6 = self.predict_flow6(c6)
+        concat5 = torch.cat([c5, self.deconv5(c6),
+                             self.upsampled_flow6_to_5(flow6)], 1)
+        flow5 = self.predict_flow5(concat5)
+        concat4 = torch.cat([c4, self.deconv4(concat5),
+                             self.upsampled_flow5_to_4(flow5)], 1)
+        flow4 = self.predict_flow4(concat4)
+        concat3 = torch.cat([c31, self.deconv3(concat4),
+                             self.upsampled_flow4_to_3(flow4)], 1)
+        flow3 = self.predict_flow3(concat3)
+        concat2 = torch.cat([c2a, self.deconv2(concat3),
+                             self.upsampled_flow3_to_2(flow3)], 1)
+        return self.predict_flow2(concat2)
+
+
+class TFlowNetS(tnn.Module):
+    def __init__(self, in_ch=12):
+        super().__init__()
+        self.conv1 = t_conv(in_ch, 64, 7, 2)
+        self.conv2 = t_conv(64, 128, 5, 2)
+        self.conv3 = t_conv(128, 256, 5, 2)
+        self.conv3_1 = t_conv(256, 256)
+        self.conv4 = t_conv(256, 512, s=2)
+        self.conv4_1 = t_conv(512, 512)
+        self.conv5 = t_conv(512, 512, s=2)
+        self.conv5_1 = t_conv(512, 512)
+        self.conv6 = t_conv(512, 1024, s=2)
+        self.conv6_1 = t_conv(1024, 1024)
+        self.deconv5 = t_deconv(1024, 512)
+        self.deconv4 = t_deconv(1026, 256)
+        self.deconv3 = t_deconv(770, 128)
+        self.deconv2 = t_deconv(386, 64)
+        self.predict_flow6 = t_predict(1024)
+        self.predict_flow5 = t_predict(1026)
+        self.predict_flow4 = t_predict(770)
+        self.predict_flow3 = t_predict(386)
+        self.predict_flow2 = t_predict(194)
+        # S variant: flow upsamplers are bias-free (ref: flownet_s.py:57-64)
+        self.upsampled_flow6_to_5 = tnn.ConvTranspose2d(2, 2, 4, 2, 1, bias=False)
+        self.upsampled_flow5_to_4 = tnn.ConvTranspose2d(2, 2, 4, 2, 1, bias=False)
+        self.upsampled_flow4_to_3 = tnn.ConvTranspose2d(2, 2, 4, 2, 1, bias=False)
+        self.upsampled_flow3_to_2 = tnn.ConvTranspose2d(2, 2, 4, 2, 1, bias=False)
+
+    def forward(self, x):
+        c2 = self.conv2(self.conv1(x))
+        c3 = self.conv3_1(self.conv3(c2))
+        c4 = self.conv4_1(self.conv4(c3))
+        c5 = self.conv5_1(self.conv5(c4))
+        c6 = self.conv6_1(self.conv6(c5))
+        flow6 = self.predict_flow6(c6)
+        concat5 = torch.cat([c5, self.deconv5(c6),
+                             self.upsampled_flow6_to_5(flow6)], 1)
+        flow5 = self.predict_flow5(concat5)
+        concat4 = torch.cat([c4, self.deconv4(concat5),
+                             self.upsampled_flow5_to_4(flow5)], 1)
+        flow4 = self.predict_flow4(concat4)
+        concat3 = torch.cat([c3, self.deconv3(concat4),
+                             self.upsampled_flow4_to_3(flow4)], 1)
+        flow3 = self.predict_flow3(concat3)
+        concat2 = torch.cat([c2, self.deconv2(concat3),
+                             self.upsampled_flow3_to_2(flow3)], 1)
+        return self.predict_flow2(concat2)
+
+
+class TFlowNetSD(tnn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv0 = t_conv(6, 64)
+        self.conv1 = t_conv(64, 64, s=2)
+        self.conv1_1 = t_conv(64, 128)
+        self.conv2 = t_conv(128, 128, s=2)
+        self.conv2_1 = t_conv(128, 128)
+        self.conv3 = t_conv(128, 256, s=2)
+        self.conv3_1 = t_conv(256, 256)
+        self.conv4 = t_conv(256, 512, s=2)
+        self.conv4_1 = t_conv(512, 512)
+        self.conv5 = t_conv(512, 512, s=2)
+        self.conv5_1 = t_conv(512, 512)
+        self.conv6 = t_conv(512, 1024, s=2)
+        self.conv6_1 = t_conv(1024, 1024)
+        self.deconv5 = t_deconv(1024, 512)
+        self.deconv4 = t_deconv(1026, 256)
+        self.deconv3 = t_deconv(770, 128)
+        self.deconv2 = t_deconv(386, 64)
+        self.inter_conv5 = t_iconv(1026, 512)
+        self.inter_conv4 = t_iconv(770, 256)
+        self.inter_conv3 = t_iconv(386, 128)
+        self.inter_conv2 = t_iconv(194, 64)
+        self.predict_flow6 = t_predict(1024)
+        self.predict_flow5 = t_predict(512)
+        self.predict_flow4 = t_predict(256)
+        self.predict_flow3 = t_predict(128)
+        self.predict_flow2 = t_predict(64)
+        self.upsampled_flow6_to_5 = tnn.ConvTranspose2d(2, 2, 4, 2, 1)
+        self.upsampled_flow5_to_4 = tnn.ConvTranspose2d(2, 2, 4, 2, 1)
+        self.upsampled_flow4_to_3 = tnn.ConvTranspose2d(2, 2, 4, 2, 1)
+        self.upsampled_flow3_to_2 = tnn.ConvTranspose2d(2, 2, 4, 2, 1)
+
+    def forward(self, x):
+        c0 = self.conv0(x)
+        c1 = self.conv1_1(self.conv1(c0))
+        c2 = self.conv2_1(self.conv2(c1))
+        c3 = self.conv3_1(self.conv3(c2))
+        c4 = self.conv4_1(self.conv4(c3))
+        c5 = self.conv5_1(self.conv5(c4))
+        c6 = self.conv6_1(self.conv6(c5))
+        flow6 = self.predict_flow6(c6)
+        concat5 = torch.cat([c5, self.deconv5(c6),
+                             self.upsampled_flow6_to_5(flow6)], 1)
+        flow5 = self.predict_flow5(self.inter_conv5(concat5))
+        concat4 = torch.cat([c4, self.deconv4(concat5),
+                             self.upsampled_flow5_to_4(flow5)], 1)
+        flow4 = self.predict_flow4(self.inter_conv4(concat4))
+        concat3 = torch.cat([c3, self.deconv3(concat4),
+                             self.upsampled_flow4_to_3(flow4)], 1)
+        flow3 = self.predict_flow3(self.inter_conv3(concat3))
+        concat2 = torch.cat([c2, self.deconv2(concat3),
+                             self.upsampled_flow3_to_2(flow3)], 1)
+        return self.predict_flow2(self.inter_conv2(concat2))
+
+
+class TFlowNetFusion(tnn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv0 = t_conv(11, 64)
+        self.conv1 = t_conv(64, 64, s=2)
+        self.conv1_1 = t_conv(64, 128)
+        self.conv2 = t_conv(128, 128, s=2)
+        self.conv2_1 = t_conv(128, 128)
+        self.deconv1 = t_deconv(128, 32)
+        self.deconv0 = t_deconv(162, 16)
+        self.inter_conv1 = t_iconv(162, 32)
+        self.inter_conv0 = t_iconv(82, 16)
+        self.predict_flow2 = t_predict(128)
+        self.predict_flow1 = t_predict(32)
+        self.predict_flow0 = t_predict(16)
+        self.upsampled_flow2_to_1 = tnn.ConvTranspose2d(2, 2, 4, 2, 1)
+        self.upsampled_flow1_to_0 = tnn.ConvTranspose2d(2, 2, 4, 2, 1)
+
+    def forward(self, x):
+        c0 = self.conv0(x)
+        c1 = self.conv1_1(self.conv1(c0))
+        c2 = self.conv2_1(self.conv2(c1))
+        flow2 = self.predict_flow2(c2)
+        concat1 = torch.cat([c1, self.deconv1(c2),
+                             self.upsampled_flow2_to_1(flow2)], 1)
+        flow1 = self.predict_flow1(self.inter_conv1(concat1))
+        concat0 = torch.cat([c0, self.deconv0(concat1),
+                             self.upsampled_flow1_to_0(flow1)], 1)
+        return self.predict_flow0(self.inter_conv0(concat0))
+
+
+class TFlowNet2(tnn.Module):
+    """Full cascade with per-subnet taps (ref: models.py:96-173)."""
+
+    def __init__(self, div_flow=20.0, rgb_max=1.0):
+        super().__init__()
+        self.div_flow, self.rgb_max = div_flow, rgb_max
+        self.flownetc = TFlowNetC()
+        self.flownets_1 = TFlowNetS()
+        self.flownets_2 = TFlowNetS()
+        self.flownets_d = TFlowNetSD()
+        self.flownetfusion = TFlowNetFusion()
+
+    def forward(self, inputs):
+        # inputs (B, 3, 2, H, W) in [0, rgb_max]
+        taps = {}
+        rgb_mean = inputs.reshape(inputs.shape[:2] + (-1,)).mean(-1) \
+            .view(inputs.shape[:2] + (1, 1, 1))
+        x = (inputs - rgb_mean) / self.rgb_max
+        x1, x2 = x[:, :, 0], x[:, :, 1]
+        x = torch.cat([x1, x2], 1)
+
+        flow2_c = taps["flownetc"] = self.flownetc(x)
+        flow_c = F.interpolate(flow2_c * self.div_flow, scale_factor=4,
+                               mode="bilinear", align_corners=False)
+        warped = t_resample(x2, flow_c)
+        concat1 = torch.cat([x, warped, flow_c / self.div_flow,
+                             t_channelnorm(x1 - warped)], 1)
+
+        flow2_s1 = taps["flownets_1"] = self.flownets_1(concat1)
+        flow_s1 = F.interpolate(flow2_s1 * self.div_flow, scale_factor=4,
+                                mode="bilinear", align_corners=False)
+        warped = t_resample(x2, flow_s1)
+        concat2 = torch.cat([x, warped, flow_s1 / self.div_flow,
+                             t_channelnorm(x1 - warped)], 1)
+
+        flow2_s2 = taps["flownets_2"] = self.flownets_2(concat2)
+        flow_s2 = F.interpolate(flow2_s2 * self.div_flow, scale_factor=4,
+                                mode="nearest")
+        flow2_sd = taps["flownets_d"] = self.flownets_d(x)
+        flow_sd = F.interpolate(flow2_sd / self.div_flow, scale_factor=4,
+                                mode="nearest")
+        concat3 = torch.cat([
+            x1, flow_sd, flow_s2, t_channelnorm(flow_sd),
+            t_channelnorm(flow_s2),
+            t_channelnorm(x1 - t_resample(x2, flow_sd)),
+            t_channelnorm(x1 - t_resample(x2, flow_s2))], 1)
+        taps["fusion"] = self.flownetfusion(concat3)
+        return taps
+
+
+@pytest.mark.slow
+class TestFlowNet2GoldenVsTorch:
+    def test_cascade_activations_match(self, tmp_path):
+        from imaginaire_tpu.flow import FlowNet2
+        from imaginaire_tpu.flow.flow_net import load_flownet2_npz
+
+        torch.manual_seed(1)
+        tnet = TFlowNet2().eval()
+        ckpt = tmp_path / "flownet2.pth"
+        torch.save({"state_dict": tnet.state_dict()}, ckpt)
+        out = tmp_path / "flownet2.npz"
+        convert_weights.convert_flownet2(str(ckpt), str(out))
+        variables = {"params": load_flownet2_npz(str(out))}
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(1, 2, 64, 64, 3).astype(np.float32)  # NHWC, [0,1]
+
+        flow, state = FlowNet2().apply(
+            variables, jnp.asarray(x), capture_intermediates=True,
+            mutable=["intermediates"])
+        inter = state["intermediates"]
+
+        with torch.no_grad():
+            # (B,2,H,W,3) -> (B,3,2,H,W)
+            tx = torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3)))
+            taps = tnet(tx)
+
+        for name in ("flownetc", "flownets_1", "flownets_2", "flownets_d"):
+            ours = np.asarray(inter[name]["__call__"][0][0])  # flow2
+            theirs = _nhwc(taps[name])
+            np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4,
+                                       err_msg=name)
+        np.testing.assert_allclose(np.asarray(flow), _nhwc(taps["fusion"]),
+                                   rtol=1e-4, atol=1e-4, err_msg="fusion")
