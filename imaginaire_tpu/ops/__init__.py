@@ -7,9 +7,11 @@ Reference ops (ref: imaginaire/third_party/):
 
 Each op has a pure-jnp implementation (differentiable; XLA autodiff turns
 the gather-style forward into the scatter-add backward the CUDA code does
-with atomicAdd) and a Pallas TPU kernel for the forward hot path wired in
-via custom_vjp. ``implementation='auto'`` picks Pallas on TPU, jnp
-elsewhere.
+with atomicAdd) and a Pallas TPU kernel reachable via
+``implementation='pallas'``. ``implementation='auto'`` always picks the
+jnp/XLA path: on-chip measurement (OPSBENCH.json, scripts/opsbench.py)
+showed XLA beating or outliving the scalar-loop kernels at every
+production shape.
 """
 
 from imaginaire_tpu.ops.resample2d import resample2d

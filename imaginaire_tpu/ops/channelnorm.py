@@ -13,7 +13,6 @@ reduction and the root in one VMEM pass.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 
@@ -27,7 +26,12 @@ def _channelnorm_jnp(x, p):
 def channelnorm(x, p=2, implementation="auto"):
     """L-p norm over the trailing channel axis of an NHWC tensor -> (B,H,W,1)."""
     if implementation == "auto":
-        implementation = "pallas" if jax.default_backend() == "tpu" else "jnp"
+        # Measured on-chip (TPU v5e): the jnp path never lost to the
+        # pallas kernel at any probed shape — XLA already fuses square,
+        # reduce and sqrt, while the kernel's (N, C) layout idles
+        # 128-wide lanes at the common C=2-3. Numbers live in
+        # OPSBENCH.json; re-run scripts/opsbench.py before changing this.
+        implementation = "jnp"
     if implementation == "jnp":
         return _channelnorm_jnp(x, p)
     if implementation in ("pallas", "pallas_interpret"):

@@ -21,7 +21,6 @@ turning the channel dot into an MXU matmul.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -81,7 +80,11 @@ def correlation(
     if pad_size < max_displacement:
         raise ValueError("pad_size must cover max_displacement")
     if implementation == "auto":
-        implementation = "jnp"  # jnp path is already MXU-friendly via XLA fusion
+        # Measured on-chip (TPU v5e): the pallas kernel's VMEM staging
+        # overflows at FlowNetC's real shapes while the lax.scan jnp path
+        # runs them in single-digit ms — jnp is the default. Numbers live
+        # in OPSBENCH.json; re-run scripts/opsbench.py before changing.
+        implementation = "jnp"
     if implementation == "jnp":
         return _correlation_jnp(x1, x2, pad_size, kernel_size, max_displacement, stride1, stride2)
     if implementation in ("pallas", "pallas_interpret"):

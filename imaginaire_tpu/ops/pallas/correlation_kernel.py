@@ -10,6 +10,13 @@ kernel gets from its shared-memory rInput staging
 
 kernel_size == 1 only (the FlowNetC configuration; the jnp path in
 ops/correlation.py supports general kernel sizes).
+
+NOTE on defaults: the full padded x2 block per program overflows VMEM at
+FlowNetC's real operating point — (1,64,128,256) needs ~18MB — and the
+TPU compile rejects it (OPSBENCH.json records the failures), while the
+jnp lax.scan path runs the same shape in single-digit ms. ``auto`` in
+ops/correlation.py therefore picks jnp; this kernel is retained for
+parity testing (interpret mode) on small shapes.
 """
 
 from __future__ import annotations

@@ -6,13 +6,16 @@ channel axis stays a full vector lane — gather on TPU is inherently
 scalar-addressed, so the inner loop walks the W pixels with
 ``lax.fori_loop`` and does 4 corner loads per pixel.
 
-NOTE on defaults: XLA's native gather lowering is faster than this
-scalar-loop kernel for large C; ``resample2d(implementation='auto')``
-therefore picks the jnp/XLA path, and this kernel exists as the native
-equivalent of the reference CUDA op (ref:
-third_party/resample2d/src/resample2d_kernel.cu:16-75) and as the base
-for future vectorized variants. Numerics match the jnp path bit-for-bit
-in fp32 (same clamp-after-weight border behavior).
+NOTE on defaults: measured on a real v5e chip (OPSBENCH.json), XLA's
+gather lowering beats this scalar-loop kernel severalfold at
+(4,64,128,128) and the kernel fails to compile (VMEM overflow: the full
+(H, W, C) source block per program) at vid2vid warp shapes like
+(2,512,1024,3).
+``resample2d(implementation='auto')`` therefore always picks jnp; this
+kernel is retained as the native equivalent of the reference CUDA op
+(ref: third_party/resample2d/src/resample2d_kernel.cu:16-75), covered by
+interpret-mode parity tests. Numerics match the jnp path bit-for-bit in
+fp32 (same clamp-after-weight border behavior).
 """
 
 from __future__ import annotations

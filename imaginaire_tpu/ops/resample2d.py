@@ -92,8 +92,12 @@ def resample2d(x, flow, implementation="auto"):
     if x.ndim != 4 or flow.ndim != 4 or flow.shape[-1] != 2:
         raise ValueError(f"resample2d expects NHWC x and (B,H,W,2) flow, got {x.shape}, {flow.shape}")
     if implementation == "auto":
-        platform = jax.default_backend()
-        implementation = "pallas" if platform == "tpu" else "jnp"
+        # Measured on-chip (TPU v5e): XLA's gather lowering beats the
+        # scalar-loop pallas kernel severalfold at every shape it even
+        # compiles at, and the kernel fails to compile (VMEM) at vid2vid
+        # warp shapes — jnp is the winner everywhere. Numbers live in
+        # OPSBENCH.json; re-run scripts/opsbench.py before changing this.
+        implementation = "jnp"
     if implementation == "jnp":
         return _bilinear_warp(x, flow)
     if implementation == "pallas":

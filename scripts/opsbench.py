@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Microbenchmark: pallas vs jnp/XLA for the native ops, on the real chip.
+
+Writes OPSBENCH.json at the repo root: per (op, impl, shape) median
+latency, plus the measured winner per op. ``implementation='auto'`` in
+ops/{resample2d,channelnorm,correlation}.py is pinned to these winners —
+re-run this script on new hardware before changing the dispatch.
+
+Shapes are the vid2vid operating points (ref: the reference runs FlowNet2
+on 512x1024 cityscapes frames; FlowNetC's cost volume runs at 1/8 res
+with 256 channels, third_party/flow_net/flownet2/networks/flownet_c.py).
+
+Timing: each measurement jits ``sum(op(...))`` and fetches the scalar to
+host — under the axon remote platform ``block_until_ready`` can ack at
+dispatch, so a device-to-host readback is the only reliable fence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+WARMUP = 2
+REPEATS = 7
+K_SMALL, K_LARGE = 2, 12
+
+
+def _looped(fn, k):
+    """Run ``fn`` k times serialized by a data dependence, so the chain
+    can't be parallelized or folded away; returns the accumulated sum."""
+
+    def run(*args):
+        def body(_, acc):
+            out = fn(args[0] + acc * 1e-30, *args[1:])
+            return acc + jnp.sum(out.astype(jnp.float32))
+
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0.0))
+
+    return jax.jit(run)
+
+
+def measure(fn, *args):
+    """Per-call latency with the host-dispatch constant cancelled: time
+    K_SMALL- and K_LARGE-iteration loops (one host readback each — under
+    axon the readback is the only reliable fence) and take the slope."""
+    times = {}
+    for k in (K_SMALL, K_LARGE):
+        wrapped = _looped(fn, k)
+        for _ in range(WARMUP):
+            float(wrapped(*args))
+        samples = []
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            float(wrapped(*args))
+            samples.append((time.perf_counter() - t0) * 1e3)
+        times[k] = statistics.median(samples)
+    # noise can push the slope of a near-free op below zero; a latency
+    # can't be negative, and winner sums must not be credited for noise
+    return max(0.0, (times[K_LARGE] - times[K_SMALL]) / (K_LARGE - K_SMALL))
+
+
+def _sanitize(msg):
+    """First line of an error, ANSI codes and machine-local URLs removed,
+    so the committed artifact documents the failure cause, not the
+    session."""
+    msg = re.sub(r"\x1b\[[0-9;]*m", "", msg)
+    msg = re.sub(r"https?://[^\s:]+(:\d+)?", "<remote-compile>", msg)
+    return msg.splitlines()[0][:200] if msg else msg
+
+
+def _run_case(cases, op, impl, shape, thunk, *args):
+    try:
+        ms = measure(thunk, *args)
+    except Exception as e:  # noqa: BLE001 - record compile failures as data
+        cases.append({"op": op, "impl": impl, "shape": list(shape),
+                      "error": _sanitize(str(e))})
+    else:
+        cases.append({"op": op, "impl": impl, "shape": list(shape),
+                      "ms": round(ms, 4)})
+    print(cases[-1], flush=True)
+
+
+def bench_resample2d(cases):
+    from imaginaire_tpu.ops.resample2d import resample2d
+
+    rng = np.random.RandomState(0)
+    for shape in ((4, 256, 512, 3), (2, 512, 1024, 3), (4, 64, 128, 128)):
+        x = jnp.asarray(rng.rand(*shape), jnp.float32)
+        flow = jnp.asarray(rng.randn(*shape[:3], 2) * 8, jnp.float32)
+        for impl in ("jnp", "pallas"):
+            _run_case(cases, "resample2d", impl, shape,
+                      lambda a, f, i=impl: resample2d(a, f, implementation=i),
+                      x, flow)
+
+
+def bench_channelnorm(cases):
+    from imaginaire_tpu.ops.channelnorm import channelnorm
+
+    rng = np.random.RandomState(0)
+    for shape in ((2, 512, 1024, 3), (4, 256, 512, 2), (4, 64, 128, 256)):
+        x = jnp.asarray(rng.rand(*shape), jnp.float32)
+        for impl in ("jnp", "pallas"):
+            _run_case(cases, "channelnorm", impl, shape,
+                      lambda a, i=impl: channelnorm(a, implementation=i), x)
+
+
+def bench_correlation(cases):
+    from imaginaire_tpu.ops.correlation import correlation
+
+    rng = np.random.RandomState(0)
+    # 1/8-res FlowNetC features: 512x1024 frame -> 64x128; smaller probe too
+    for shape in ((1, 64, 128, 256), (1, 32, 64, 256)):
+        x1 = jnp.asarray(rng.rand(*shape), jnp.float32)
+        x2 = jnp.asarray(rng.rand(*shape), jnp.float32)
+        for impl in ("jnp", "pallas"):
+            _run_case(cases, "correlation", impl, shape,
+                      lambda a, b, i=impl: correlation(a, b, implementation=i),
+                      x1, x2)
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev, flush=True)
+    cases = []
+    bench_resample2d(cases)
+    bench_channelnorm(cases)
+    bench_correlation(cases)
+
+    winners = {}
+    for op in ("resample2d", "channelnorm", "correlation"):
+        op_cases = [item for item in cases if item["op"] == op]
+        shapes = {tuple(item["shape"]) for item in op_cases}
+        totals, failed = {}, set()
+        for item in op_cases:
+            if "ms" in item:
+                totals.setdefault(item["impl"], []).append(item["ms"])
+            else:
+                failed.add(item["impl"])
+        # only an impl that ran EVERY shape cleanly can be the default;
+        # then all qualifying sums cover the identical shape set
+        ran = {impl: sum(ms) for impl, ms in totals.items()
+               if impl not in failed and len(ms) == len(shapes)}
+        winners[op] = min(ran, key=ran.get) if ran else "jnp"
+
+    out = {"device": str(dev), "platform": dev.platform,
+           "method": f"slope between {K_SMALL}- and {K_LARGE}-iteration "
+                     f"fori_loop chains, median of {REPEATS}",
+           "cases": cases, "winners": winners}
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "OPSBENCH.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"winners": winners}))
+
+
+if __name__ == "__main__":
+    main()

@@ -251,18 +251,16 @@ class TestInceptionGoldenVsTorch:
         sd = {k: v.numpy() for k, v in tnet.state_dict().items()}
         flat = convert_weights.inception_state_to_npz(sd)
 
+        path = str(tmp_path / "inception_f64.npz")
+        np.savez(path, **flat)
         jax.config.update("jax_enable_x64", True)
         try:
-            params = {}
-            for k, v in flat.items():
-                node = params
-                parts = k.split("/")
-                for p in parts[:-1]:
-                    node = node.setdefault(p, {})
-                node[parts[-1]] = jnp.asarray(v, jnp.float64)
+            from imaginaire_tpu.evaluation.inception import load_params
+
+            variables = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.float64), load_params(path))
             x = np.random.RandomState(3).rand(1, 299, 299, 3) * 2 - 1
-            ours = np.asarray(InceptionV3().apply({"params": params},
-                                                  jnp.asarray(x)))
+            ours = np.asarray(InceptionV3().apply(variables, jnp.asarray(x)))
         finally:
             jax.config.update("jax_enable_x64", False)
         with torch.no_grad():
