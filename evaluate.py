@@ -84,8 +84,14 @@ def main():
         extra_requested = [m for m in metrics if m != "fid"]
         extra = trainer.compute_extra_metrics(extra_requested)
         if extra_requested and not extra:
-            print(f"  note: {type(trainer).__module__} computes no extra "
-                  f"metrics for {extra_requested}")
+            # argparse already rejected names outside {fid,kid,prdc}, so
+            # an empty result means the trainer/runtime couldn't produce
+            # the valid request — fail instead of a silent partial sweep
+            raise SystemExit(
+                f"--metrics {','.join(extra_requested)} requested but "
+                f"{type(trainer).__module__} produced none (unsupported "
+                "for this trainer, missing inception weights, or a val "
+                "set without sequence pinning)")
         for name, value in extra.items():
             print(f"  {name}: {value:.5f}")
     print("Done with evaluation!!!")

@@ -388,10 +388,74 @@ class BaseTrainer:
     def _compute_fid(self):
         return None
 
+    def _extra_metric_activations(self, extractor):
+        """Return (act_real, act_fake) Inception activations for KID/PRDC,
+        or None when the trainer family doesn't support them. Image
+        trainers use get_activations over the val loader; video trainers
+        the pinned-sequence rollout (get_video_activations)."""
+        return None
+
+    def _cached_real_activations(self, cache_name, compute):
+        """Real-set activations are identical across a checkpoint sweep —
+        cache them beside the logdir like the FID real stats (tagged with
+        the inception feature-graph version so a changed extractor
+        recomputes). Random-init extractors (tests) never cache: their
+        features change per process."""
+        import os
+
+        import numpy as np
+
+        from imaginaire_tpu.evaluation.fid import FEATURE_GRAPH_VERSION
+
+        if cfg_get(cfg_get(self.cfg, "trainer", {}), "fid_random_init",
+                   False):
+            return compute()
+        path = os.path.join(cfg_get(self.cfg, "logdir", "."), cache_name)
+        if os.path.exists(path):
+            npz = np.load(path)
+            if int(npz.get("graph_version", 0)) == FEATURE_GRAPH_VERSION:
+                return npz["acts"]
+        acts = compute()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.savez(path, acts=acts, graph_version=FEATURE_GRAPH_VERSION)
+        return acts
+
     def compute_extra_metrics(self, metrics):
-        """Optional extra eval metrics ('kid', 'prdc') -> {name: value}.
-        Image trainer families implement this; default none."""
-        return {}
+        """KID / PRDC -> {name: value} — metrics the reference ships as
+        library code (evaluation/kid.py, prdc.py) but never wires into
+        its evaluate sweep; here evaluate.py --metrics does. The trainer
+        family supplies activations via _extra_metric_activations; one
+        (real, fake) pass feeds both metrics."""
+        out = {}
+        metrics = {str(m).lower() for m in (metrics or ())}
+        unknown = metrics - {"kid", "prdc"}
+        if unknown:
+            print(f"Unknown extra metrics ignored: {sorted(unknown)}")
+        metrics &= {"kid", "prdc"}
+        if not metrics or self.val_data_loader is None:
+            return out
+        try:
+            extractor = self._fid_extractor()
+        except FileNotFoundError as e:
+            print(f"extra metrics skipped: {e}")
+            return out
+        acts = self._extra_metric_activations(extractor)
+        if acts is None:
+            return out
+        act_real, act_fake = acts
+
+        from imaginaire_tpu.evaluation.kid import kid_from_activations
+        from imaginaire_tpu.evaluation.prdc import prdc_from_activations
+
+        if "kid" in metrics:
+            out["KID"] = float(kid_from_activations(act_real, act_fake))
+        if "prdc" in metrics:
+            prdc = prdc_from_activations(act_real, act_fake)
+            out.update({f"PRDC_{k}": float(v) for k, v in prdc.items()})
+        for name, value in out.items():
+            self._meter(name).write(value)
+        self._flush_meters(self.current_iteration)
+        return out
 
     def write_metrics(self):
         """FID + best-FID tracking (ref: base.py:467-479)."""

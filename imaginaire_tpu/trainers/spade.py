@@ -186,44 +186,22 @@ class Trainer(BaseTrainer):
             return out["fake_images"]
         return gen_fn
 
-    def compute_extra_metrics(self, metrics):
-        """KID / PRDC over the validation set — metrics the reference
-        ships as library code (evaluation/kid.py, prdc.py) but never
-        wires into its evaluate sweep; here evaluate.py --metrics does.
-        One (real, fake) activation pass feeds both metrics."""
-        out = {}
-        metrics = {str(m).lower() for m in (metrics or ())}
-        unknown = metrics - {"kid", "prdc"}
-        if unknown:
-            print(f"Unknown extra metrics ignored: {sorted(unknown)}")
-        metrics &= {"kid", "prdc"}
-        if not metrics or self.val_data_loader is None:
-            return out
-        try:
-            extractor = self._fid_extractor()
-        except FileNotFoundError as e:
-            print(f"extra metrics skipped: {e}")
-            return out
-
+    def _extra_metric_activations(self, extractor):
+        """Image-family activations for KID/PRDC (base template at
+        trainers/base.py::compute_extra_metrics); real-set activations
+        are cached across a checkpoint sweep."""
         from imaginaire_tpu.evaluation.common import get_activations
-        from imaginaire_tpu.evaluation.kid import kid_from_activations
-        from imaginaire_tpu.evaluation.prdc import prdc_from_activations
 
         gen_fn = self._make_eval_gen_fn(self.inference_params())
         act_fake = get_activations(self.val_data_loader, "images",
                                    "fake_images", extractor,
                                    generator_fn=gen_fn)
-        act_real = get_activations(self.val_data_loader, "images",
-                                   "fake_images", extractor)
-        if "kid" in metrics:
-            out["KID"] = float(kid_from_activations(act_real, act_fake))
-        if "prdc" in metrics:
-            prdc = prdc_from_activations(act_real, act_fake)
-            out.update({f"PRDC_{k}": float(v) for k, v in prdc.items()})
-        for name, value in out.items():
-            self._meter(name).write(value)
-        self._flush_meters(self.current_iteration)
-        return out
+        data_name = cfg_get(cfg_get(self.cfg, "data", {}), "name", "data")
+        act_real = self._cached_real_activations(
+            f"real_acts_{data_name}.npz",
+            lambda: get_activations(self.val_data_loader, "images",
+                                    "fake_images", extractor))
+        return act_real, act_fake
 
     def _compute_fid(self):
         """FID for the regular and (if enabled) EMA generator

@@ -665,6 +665,34 @@ class Trainer(BaseTrainer):
             fid_path, self._frame_loader(dataset), extractor, None,
             trainer=self, is_video=True, sample_size=sample_size))
 
+    def _extra_metric_activations(self, extractor):
+        """Video-family activations for KID/PRDC (base template at
+        trainers/base.py::compute_extra_metrics): the same pinned-sequence
+        rollout as video FID (``get_video_activations``); real-set
+        activations are cached across a checkpoint sweep
+        (ref: evaluation/kid.py:29, prdc.py)."""
+        dataset = getattr(self.val_data_loader, "dataset", None)
+        if dataset is None or not hasattr(dataset,
+                                          "set_inference_sequence_idx"):
+            print("Video KID/PRDC skipped: val dataset has no sequence "
+                  "pinning (set_inference_sequence_idx).")
+            return None
+
+        from imaginaire_tpu.evaluation.common import get_video_activations
+
+        sample_size = cfg_get(self.cfg.trainer, "num_videos_to_test", 64)
+        frame_loader = self._frame_loader(dataset)
+        act_fake = get_video_activations(frame_loader, "images",
+                                         "fake_images", self, extractor,
+                                         sample_size=sample_size)
+        data_name = cfg_get(cfg_get(self.cfg, "data", {}), "name", "data")
+        act_real = self._cached_real_activations(
+            f"real_acts_video_{data_name}.npz",
+            lambda: get_video_activations(frame_loader, "images",
+                                          "fake_images", None, extractor,
+                                          sample_size=sample_size))
+        return act_real, act_fake
+
     def dis_update(self, data):
         """D updates happen inside gen_update's rollout
         (ref: trainers/vid2vid.py:290-296)."""

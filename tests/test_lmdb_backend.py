@@ -1,0 +1,60 @@
+"""LMDB backend coverage (ref: imaginaire/datasets/lmdb.py:17-79,
+utils/lmdb.py:56-129).
+
+The CI image does not ship the ``lmdb`` package, so the round-trip test
+skips VISIBLY (it runs anywhere lmdb is installed); the always-run tests
+pin the loud import-gate errors so the backend can never silently
+pretend to work without its dependency. README flags the backend as
+untested in this image.
+"""
+
+import numpy as np
+import pytest
+
+from imaginaire_tpu.data.backends import LMDBBackend, build_lmdb_dataset
+
+
+class TestImportGate:
+    def test_reader_raises_loudly_without_lmdb(self, tmp_path):
+        try:
+            import lmdb  # noqa: F401
+            pytest.skip("lmdb installed; gate path not reachable")
+        except ImportError:
+            pass
+        with pytest.raises(ImportError, match="lmdb.*not installed"):
+            LMDBBackend(str(tmp_path))
+
+    def test_writer_raises_loudly_without_lmdb(self, tmp_path):
+        try:
+            import lmdb  # noqa: F401
+            pytest.skip("lmdb installed; gate path not reachable")
+        except ImportError:
+            pass
+        with pytest.raises(ImportError, match="lmdb.*not installed"):
+            build_lmdb_dataset(str(tmp_path), str(tmp_path / "out"),
+                               ["images"])
+
+
+class TestRoundTrip:
+    def test_build_then_read(self, tmp_path):
+        """Writer -> reader round trip through the real lmdb package
+        (runs only where lmdb is installed; skips visibly here).
+        Layout: data_root/<type>/<sequence>/<stem>.<ext>, LMDB key
+        '<sequence>/<stem>' (ref: utils/lmdb.py:56-129)."""
+        pytest.importorskip("lmdb")
+        import cv2
+
+        root = tmp_path / "raw"
+        (root / "images" / "seq0").mkdir(parents=True)
+        rng = np.random.RandomState(0)
+        for name in ("a", "b"):
+            cv2.imwrite(str(root / "images" / "seq0" / f"{name}.png"),
+                        rng.randint(0, 255, (16, 16, 3), np.uint8))
+        out = tmp_path / "lmdb"
+        build_lmdb_dataset(str(root), str(out), ["images"])
+
+        backend = LMDBBackend(str(out / "images"))
+        img = backend.getitem("seq0/a")
+        assert img.shape[:2] == (16, 16)
+        with pytest.raises(KeyError):
+            backend.getitem("seq0/missing")

@@ -225,6 +225,39 @@ class TestVideoFID:
         import glob
         assert glob.glob(str(tmp_path) + "/real_stats_video_*.npz")
 
+    def test_video_kid_prdc(self, tmp_path):
+        """Video-family KID/PRDC: the same pinned-sequence rollout as
+        video FID feeds kid/prdc_from_activations
+        (ref: evaluation/kid.py:29, prdc.py)."""
+        from imaginaire_tpu.data.loader import DataLoader
+
+        cfg = Config(CFG)
+        cfg.logdir = str(tmp_path)
+        cfg.trainer.fid_random_init = True
+        cfg.trainer.num_videos_to_test = 1
+        ds_cls = resolve(cfg.data.type, "Dataset")
+        val_ds = ds_cls(cfg, is_inference=True)
+        loader = DataLoader(val_ds, batch_size=1, shuffle=False,
+                            drop_last=False)
+        trainer = resolve(cfg.trainer.type, "Trainer")(
+            cfg, val_data_loader=loader)
+        rng = np.random.RandomState(0)
+        batch = {
+            "images": jnp.asarray(
+                rng.rand(1, 3, 64, 64, 3).astype(np.float32)) * 2 - 1,
+            "label": jnp.asarray(
+                (rng.rand(1, 3, 64, 64, 12) > 0.9).astype(np.float32)),
+        }
+        trainer.init_state(jax.random.PRNGKey(0), batch)
+        out = trainer.compute_extra_metrics(["kid", "prdc"])
+        assert np.isfinite(out["KID"])
+        for k in ("precision", "recall", "density", "coverage"):
+            v = out[f"PRDC_{k}"]
+            assert np.isfinite(v) and 0.0 <= v, (k, v)
+        # unsupported requests return {} (evaluate.py turns that into a
+        # hard failure)
+        assert trainer.compute_extra_metrics(["nope"]) == {}
+
 
 @pytest.mark.slow
 class TestVideoInference:
@@ -252,3 +285,42 @@ class TestVideoInference:
         import glob
         frames = sorted(glob.glob(out_dir + "/seq0000/*.jpg"))
         assert len(frames) == 3  # all fixture frames, not just frame 0
+
+
+@pytest.mark.slow
+class TestMultiDeviceVid2Vid:
+    def test_sharded_interleaved_rollout(self, rng, tmp_path):
+        """The interleaved per-frame D/G rollout with a temporal D,
+        batch sharded over the 8-device 'data' mesh — the framework's
+        most complex multi-device path (VERDICT r2 #4; ref:
+        imaginaire/trainers/vid2vid.py:238-288)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from imaginaire_tpu.parallel.mesh import create_mesh, get_mesh, set_mesh
+
+        old = get_mesh()
+        try:
+            mesh = create_mesh(("data",))
+            set_mesh(mesh)
+            cfg = Config(CFG)
+            cfg.logdir = str(tmp_path)
+            trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+            n = mesh.devices.size
+            batch = {
+                "images": jnp.asarray(
+                    rng.rand(n, 3, 64, 64, 3).astype(np.float32)) * 2 - 1,
+                "label": jnp.asarray(
+                    (rng.rand(n, 3, 64, 64, 12) > 0.9).astype(np.float32)),
+            }
+            trainer.init_state(jax.random.PRNGKey(0), batch)
+            trainer.state = jax.device_put(trainer.state,
+                                           NamedSharding(mesh, P()))
+            batch = jax.device_put(batch, NamedSharding(mesh, P("data")))
+            with mesh:
+                batch = trainer.start_of_iteration(batch, 1)
+                g = trainer.gen_update(batch)  # per-frame D updates inside
+            for name, v in g.items():
+                assert np.isfinite(float(jax.device_get(v))), name
+            assert any(k.startswith("GAN_T") for k in g), g.keys()
+        finally:
+            set_mesh(old)
