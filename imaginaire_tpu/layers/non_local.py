@@ -18,17 +18,23 @@ class NonLocal2dBlock(nn.Module):
 
     ``ring_axis``: run the attention as ring attention over that mesh
     axis (sequence/context parallelism, parallel/ring_attention.py) —
-    for feature maps whose token count exceeds one device, when the
-    block executes inside a shard_map with H sharded over the axis.
-    The pooled-key memory optimization is skipped in ring mode (the
-    ring already bounds per-device memory). Initialize with the
-    ring_axis='' twin (identical param tree) — collectives are unbound
-    outside shard_map."""
+    for feature maps whose token count exceeds one device. With
+    ``ring_shard_map`` (the default) the block wraps ONLY its attention
+    core in a shard_map island over the process mesh, sharding the
+    token axis over ``ring_axis`` — so it drops into a stock jitted
+    training step (XLA GSPMD partitions the surrounding convs; the
+    island pins the attention to the ring schedule). Set
+    ``ring_shard_map=False`` when the block already executes inside an
+    outer shard_map with tokens sharded over the axis. The pooled-key
+    memory optimization is skipped in ring mode (the ring already
+    bounds per-device memory). Initialize with the ring_axis='' twin
+    (identical param tree) — collectives are unbound outside a mesh."""
 
     scale: bool = True
     clamp: bool = False
     weight_norm_type: str = "spectral"
     ring_axis: str = ""
+    ring_shard_map: bool = True
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -50,7 +56,35 @@ class NonLocal2dBlock(nn.Module):
                 b, h * w, 1, ch)
             k = conv(ch, "phi")(x, training=training).reshape(b, h * w, 1, ch)
             v = conv(cg, "g")(x, training=training).reshape(b, h * w, 1, cg)
-            y = ring_attention(q, k, v, self.ring_axis, scale=1.0)
+            if self.ring_shard_map:
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                from imaginaire_tpu.parallel.mesh import get_mesh
+
+                mesh = get_mesh()
+                if mesh is None or self.ring_axis not in mesh.axis_names:
+                    raise ValueError(
+                        f"non_local ring_axis={self.ring_axis!r} needs a "
+                        f"process mesh with that axis (have "
+                        f"{getattr(mesh, 'axis_names', None)}); create it "
+                        "via parallel.mesh.set_mesh or set ring_axis: ''")
+                # shard the batch over 'data' too when it divides —
+                # P(None, seq) would all-gather the batch into every
+                # data-parallel row and redo identical attention there
+                batch_axis = None
+                if "data" in mesh.axis_names and self.ring_axis != "data":
+                    if b % dict(zip(mesh.axis_names,
+                                    mesh.devices.shape))["data"] == 0:
+                        batch_axis = "data"
+                spec = P(batch_axis, self.ring_axis)
+                y = shard_map(
+                    lambda q_, k_, v_: ring_attention(
+                        q_, k_, v_, self.ring_axis, scale=1.0),
+                    mesh=mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec)(q, k, v)
+            else:
+                y = ring_attention(q, k, v, self.ring_axis, scale=1.0)
             y = y.reshape(b, h, w, cg)
         else:
             theta = conv(ch, "theta")(x, training=training).reshape(

@@ -86,6 +86,7 @@ class Generator(nn.Module):
             skip_activation_norm=cfg_get(gen_cfg, "skip_activation_norm", True),
             use_posenc_in_input_layer=cfg_get(gen_cfg, "use_posenc_in_input_layer", True),
             use_style_encoder=self.use_style_encoder,
+            non_local_params=dict(cfg_get(gen_cfg, "non_local", None) or {}),
         )
         if self.use_style:
             se_cfg = dict(cfg_get(gen_cfg, "style_enc", None) or {})
@@ -138,6 +139,12 @@ class SPADEGenerator(nn.Module):
     skip_activation_norm: bool
     use_posenc_in_input_layer: bool
     use_style_encoder: bool
+    # {'enabled': True, 'ring_axis': 'seq', 'weight_norm_type': ...} adds a
+    # SAGAN self-attention block at the 64-token-side stage (the reference
+    # ships layers/non_local.py but never wires it into a generator; this
+    # knob makes it — and its ring-attention sequence-parallel mode —
+    # reachable from configs).
+    non_local_params: Any = None
 
     @property
     def base(self):
@@ -233,6 +240,15 @@ class SPADEGenerator(nn.Module):
         else:
             x = plain_block(4 * nf, "conv_up_1a")(x, training=training)
         x = res_block(4 * nf, "up_1b")(x, seg, training=training)
+        nl = dict(self.non_local_params or {})
+        if nl.get("enabled"):
+            from imaginaire_tpu.layers.non_local import NonLocal2dBlock
+
+            x = NonLocal2dBlock(
+                weight_norm_type=nl.get("weight_norm_type",
+                                        self.weight_norm_type),
+                ring_axis=nl.get("ring_axis", ""),
+                name="non_local")(x, training=training)
         x = upsample_2x(x)
         # 128x128
         x = res_block(4 * nf, "up_2a")(x, seg, training=training)

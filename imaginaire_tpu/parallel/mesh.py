@@ -45,17 +45,22 @@ def create_mesh(axes=("data",), shape=None, devices=None):
     """Create a Mesh over the given logical axes.
 
     ``shape=None`` puts every device on the first axis (pure DP, the
-    reference's only parallelism mode). An explicit shape like
-    ``{"data": 4, "model": 2}`` builds a 2-D mesh.
+    reference's only parallelism mode). An explicit shape — a mapping
+    like ``{"data": 4, "model": 2}`` or a sequence aligned with ``axes``
+    like ``(4, 2)`` — builds an N-D mesh.
     """
     devices = np.asarray(devices if devices is not None else jax.devices())
     axes = tuple(axes)
     if shape is None:
         dims = [devices.size] + [1] * (len(axes) - 1)
+    elif isinstance(shape, (list, tuple)):
+        if len(shape) != len(axes):
+            raise ValueError(f"shape {shape} does not align with axes {axes}")
+        dims = [int(s) for s in shape]
     else:
         dims = [int(shape[a]) if (hasattr(shape, "__getitem__") and a in shape) else 1 for a in axes]
-        if int(np.prod(dims)) != devices.size:
-            raise ValueError(f"mesh shape {dims} != device count {devices.size}")
+    if int(np.prod(dims)) != devices.size:
+        raise ValueError(f"mesh shape {dims} != device count {devices.size}")
     return Mesh(devices.reshape(dims), axes)
 
 
