@@ -118,6 +118,13 @@ class Augmentor:
             if data_type in self.keypoint_data_types:
                 out[data_type] = [self._apply_keypoints(f, ops) for f in frames]
                 continue
+            if frames and not hasattr(frames[0], "shape"):
+                # non-spatial payloads (e.g. pickled unprojection mappings,
+                # ext: pkl) pass through untouched — their convert:: op
+                # decodes them after augmentation (ref: the reference's
+                # augmentable-type split in datasets/base.py)
+                out[data_type] = frames
+                continue
             interp = self._interp(data_type)
             out[data_type] = [self._apply(f, ops, interp) for f in frames]
         return out, is_flipped
@@ -127,9 +134,13 @@ class Augmentor:
 
         OpenPose frames arrive as dicts of keypoint groups
         ({pose, face, hand_l, hand_r}, see visualization.pose
-        openpose_to_npy) — each group is co-transformed."""
+        openpose_to_npy) — or, multi-person (openpose_to_npy without
+        largest-only), as a LIST of such dicts — each group of each
+        person is co-transformed."""
         if isinstance(pts, dict):
             return {k: self._apply_keypoints(v, ops) for k, v in pts.items()}
+        if isinstance(pts, list):
+            return [self._apply_keypoints(p, ops) for p in pts]
         if pts is None:
             return None
         pts = np.asarray(pts, np.float32).copy()

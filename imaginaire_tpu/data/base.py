@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import importlib
 import os
+import threading
 
 import numpy as np
 
@@ -33,6 +34,8 @@ class BaseDataset:
         self.cfg = cfg
         self.is_inference = is_inference
         self.is_test = is_test
+        self._common_attr = None
+        self._common_attr_lock = threading.Lock()
         self.cfgdata = cfg.test_data if is_test else cfg.data
         data_info = (self.cfgdata.test if is_test
                      else (self.cfgdata.val if is_inference else self.cfgdata.train))
@@ -139,9 +142,15 @@ class BaseDataset:
                 data[t] = [backend.getitem(k) for k in keys]
         return data
 
-    def process_item(self, data):
+    def process_item(self, data, thread_common_attr=True):
         """pre-ops -> joint augmentation -> post-ops -> normalize/one-hot ->
-        concat labels. Returns dict of (T,H,W,C) or (H,W,C) float arrays."""
+        concat labels. Returns dict of (T,H,W,C) or (H,W,C) float arrays.
+
+        ``thread_common_attr=False`` processes the item WITHOUT reading or
+        writing the sequence-level common-attribute stash — the few-shot
+        reference window must compute its own person bbox, not inherit
+        the driving window's (ref: fs_vid2vid.py:242-256 computes
+        ref_crop_coords separately)."""
         # Key the 0-255 -> 0-1 rescale off the SOURCE dtype, not a value
         # heuristic (float-valued data like .npy flow fields can exceed
         # 1.5 and must not be divided by 255).
@@ -162,17 +171,46 @@ class BaseDataset:
                 try:
                     kp_copies[t + "_xy"] = np.stack(
                         [np.asarray(f, np.float32) for f in frames])
-                except ValueError:
-                    pass  # ragged per-frame keypoint counts: skip the stash
+                except (ValueError, TypeError):
+                    # ragged per-frame keypoint counts, or structured
+                    # multi-person lists (openpose_to_npy without
+                    # largest-only): no flat stash
+                    pass
         data = self._apply_ops(data, self.post_aug_ops)
         data.update(kp_copies)
+        # thread common attributes (e.g. crop_person_from_data's inference
+        # crop bbox) from the first processed window into later windows of
+        # the same sequence (ref: paired_few_shot_videos.py:296-312;
+        # cleared by set_inference_sequence_idx). The loader's prefetch
+        # workers are THREADS over this shared dataset, so the stash is
+        # lock-protected; windows that started before the first stash
+        # landed still compute their own bbox (same first-windows caveat
+        # as the reference's worker-index dance). The sequential eval
+        # frame loaders (video FID / test loops) are unaffected.
+        if thread_common_attr and self.is_inference:
+            with self._common_attr_lock:
+                if getattr(self, "_common_attr", None):
+                    data.setdefault("common_attr", self._common_attr)
         data = self._apply_full_data_ops(data)
+        if "common_attr" in data:
+            stashed = data.pop("common_attr")
+            if thread_common_attr and self.is_inference:
+                with self._common_attr_lock:
+                    self._common_attr = stashed
 
         out = {}
         for k in kp_copies:
             if k in data:
                 out[k] = data[k]
         for t in self.data_types:
+            if t not in data:
+                continue  # consumed by a full-data op (e.g. instance maps)
+            if not isinstance(data[t], (list, tuple)):
+                # a convert:: op replaced the frame list with a structured
+                # payload (e.g. decode_unprojections' {resolution: array}
+                # dict) — pass it through; consumers read it directly
+                out[t] = data[t]
+                continue
             frames = []
             for arr in data[t]:
                 arr = np.asarray(arr)

@@ -37,6 +37,9 @@ class Dataset(VideoDataset):
         self.inference_k_shot_frame_index = k_shot_frame_index
         self.epoch_length = len(
             self.sequences[self.inference_sequence_idx][2])
+        # a new sequence must not inherit the previous one's
+        # threaded common attributes (e.g. the person-crop bbox)
+        self._common_attr = None
 
     def set_few_shot_K(self, k):
         self.few_shot_K = int(k)
@@ -72,7 +75,9 @@ class Dataset(VideoDataset):
         out = self.process_item(raw)
         out = self.concat_labels(out)
         ref_raw = self.load_item(ref_root, ref_seq, ref_frames)
-        ref = self.process_item(ref_raw)
+        # the reference window computes its OWN person bbox — it must not
+        # inherit (or overwrite) the driving window's stashed crop
+        ref = self.process_item(ref_raw, thread_common_attr=False)
         ref = self.concat_labels(ref)
         out["ref_images"] = ref["images"]  # (K, H, W, C)
         if "label" in ref:

@@ -58,6 +58,9 @@ class Dataset(BaseDataset):
         self.inference_sequence_idx = index % len(self.sequences)
         self.epoch_length = len(
             self.sequences[self.inference_sequence_idx][2])
+        # a new sequence must not inherit the previous one's
+        # threaded common attributes (e.g. the person-crop bbox)
+        self._common_attr = None
 
     def _rebuild(self):
         self.valid = [s for s in self.sequences

@@ -158,12 +158,15 @@ def get_face_bbox_for_data(keypoints, orig_img_size, scale, is_inference,
     return [y0, y1, x0, x1], scale
 
 
-def crop_and_resize(arrays, crop_coords, size):
+def crop_and_resize(arrays, crop_coords, size, method="bilinear"):
     """Crop (T, H, W, C) stacks and resize to ``size``
-    (ref: fs_vid2vid.py:223-258)."""
+    (ref: fs_vid2vid.py:223-258). ``method='nearest'`` keeps discrete
+    label/mask values crisp."""
     import cv2
     import numpy as np
 
+    interp = (cv2.INTER_NEAREST if str(method).lower().startswith("nearest")
+              else cv2.INTER_LINEAR)
     y0, y1, x0, x1 = crop_coords
     out = []
     for arr in arrays:
@@ -174,8 +177,7 @@ def crop_and_resize(arrays, crop_coords, size):
         frames = []
         for f in arr:
             c = f[y0:y1, x0:x1]
-            c = cv2.resize(c, (size[1], size[0]),
-                           interpolation=cv2.INTER_LINEAR)
+            c = cv2.resize(c, (size[1], size[0]), interpolation=interp)
             if c.ndim == 2:
                 c = c[:, :, None]
             frames.append(c)
@@ -212,6 +214,157 @@ def crop_face_from_data(cfg, is_inference, data):
         data["ref_images"] = ref_images
         if ref_label is not None:
             data["ref_labels"] = ref_label
+    return data
+
+
+def remove_other_ppl(labels, densemasks):
+    """Keep only the target person in a pose label map by matching the
+    DensePose instance id with the OpenPose channels' support
+    (ref: fs_vid2vid.py:352-375). labels (T, H, W, C) with densepose in
+    channels 0:3 and openpose in 3:, densemasks (T, H, W, >=1)."""
+    labels = np.array(labels, copy=True)
+    masks = (np.asarray(densemasks)[..., 0] * 255).astype(np.int64)
+    for idx in range(labels.shape[0]):
+        label, densemask = labels[idx], masks[idx]
+        openpose = label[..., 3:]
+        valid = np.any(openpose[..., :3] > 0, axis=-1)
+        dp_valid = densemask[valid]
+        if dp_valid.size:
+            ind = np.bincount(dp_valid).argmax()
+            labels[idx] = label * (densemask == ind)[..., None]
+    return labels
+
+
+def get_person_bbox_for_data(pose_map, orig_img_size, scale=1.5,
+                             crop_aspect_ratio=1.0, offset=None):
+    """Pixel bbox [y0, y1, x0, x1] covering the person body region of a
+    (T, H, W, C) pose map (ref: fs_vid2vid.py:281-321): the support of
+    the first 3 (densepose) channels, grown by ``scale`` with a minimum
+    of half the frame height, center-clamped into the frame."""
+    h, w = orig_img_size
+    pose_map = np.asarray(pose_map)
+    ys, xs = np.nonzero(np.any(pose_map[..., :3] > 0, axis=(0, -1)))
+    if ys.size == 0:
+        bw = int(h * crop_aspect_ratio // 2)
+        return [0, h, w // 2 - bw, w // 2 + bw]
+    y_min, y_max = int(ys.min()), int(ys.max())
+    x_min, x_max = int(xs.min()), int(xs.max())
+    y_cen, x_cen = (y_min + y_max) // 2, (x_min + x_max) // 2
+    y_len, x_len = y_max - y_min, x_max - x_min
+
+    bh = int(min(h, max(h // 2, y_len * scale))) // 2
+    bh = max(bh, int(x_len * scale / crop_aspect_ratio) // 2)
+    bw = int(bh * crop_aspect_ratio)
+    if offset is not None:
+        x_cen += int(offset[0] * bw)
+        y_cen += int(offset[1] * bh)
+    x_cen = max(bw, min(w - bw, x_cen))
+    y_cen = max(bh, min(h - bh, y_cen))
+    return [y_cen - bh, y_cen + bh, x_cen - bw, x_cen + bw]
+
+
+def crop_person_from_data(cfg, is_inference, data, rng=None):
+    """Crop every data type's frames to the person body region and resize
+    to cfg.output_h_w (ref: fs_vid2vid.py:196-278) — the pose twin of
+    crop_face_from_data, registered as a ``full_data_ops`` entry.
+
+    Runs at this pipeline's full-data stage (data/base.py::process_item):
+    ``data`` maps each configured type to its LIST of per-frame (H, W, C)
+    arrays, before per-type normalization and label concat. The person
+    bbox comes from the DensePose pose map ('pose_maps-densepose');
+    DensePose instance maps ('human_instance_maps'), when present, mask
+    bystanders out of the pose/openpose label types first. In inference
+    the crop coordinates are stashed in data['common_attr'] so later
+    windows of the same sequence can reuse them
+    (ref: fs_vid2vid.py:242-246). The few-shot reference window arrives
+    as a SEPARATE full-data call (paired_few_shot_videos.py processes
+    refs independently), so each call computes one bbox."""
+    from imaginaire_tpu.config import cfg_get
+
+    dp_key = "pose_maps-densepose"
+    if dp_key not in data:
+        return data
+    dp = np.stack([np.asarray(f, np.float32) for f in data[dp_key]])
+    op_key = "pose_maps-openpose" if "pose_maps-openpose" in data else \
+        "poses-openpose"
+    rendered_op = None
+    if op_key in data and hasattr(data[op_key][0], "shape"):
+        rendered_op = np.stack([np.asarray(f, np.float32)
+                                for f in data[op_key]])
+
+    if "human_instance_maps" in data:
+        inst = np.stack([np.asarray(f, np.float32) / 255.0
+                         for f in data["human_instance_maps"]])
+        # bystander removal needs openpose support in channels 3:; build
+        # the (densepose, openpose) pair the reference concatenates
+        if rendered_op is not None:
+            pair = remove_other_ppl(
+                np.concatenate([dp, rendered_op], axis=-1), inst)
+            dp = pair[..., :dp.shape[-1]]
+            rendered_op = pair[..., dp.shape[-1]:]
+            data[op_key] = list(rendered_op)
+        else:
+            dp = dp * (inst[..., :1] > 0)
+        data[dp_key] = list(dp)
+
+    h, w = [int(v) for v in str(cfg_get(cfg, "output_h_w", "256,256")
+                                ).split(",")]
+    aspect = w / h
+    img_size = dp.shape[1:3]
+    offset = None
+    scale = 1.5
+    if not is_inference:
+        rng = rng or np.random  # file convention: seedable jitter
+        offset = np.clip(rng.randn(2) * 0.05, -1, 1)
+        scale = min(2, max(1, scale + float(rng.randn()) * 0.05))
+
+    if "common_attr" in data and "crop_coords" in data["common_attr"]:
+        crop_coords = data["common_attr"]["crop_coords"]
+    else:
+        crop_coords = get_person_bbox_for_data(dp, img_size, scale,
+                                               aspect, offset)
+    # the width-driven bbox branch can overrun the frame; clamp BEFORE
+    # use so the pixel crop and the keypoint rescale share one geometry
+    ih, iw = img_size
+    y0, y1, x0, x1 = crop_coords
+    y0, x0 = max(0, y0), max(0, x0)
+    y1, x1 = min(ih, y1), min(iw, x1)
+    crop_coords = [y0, y1, x0, x1]
+    # honor each type's configured interpolator (the augmentor already
+    # does): NEAREST keeps discrete DensePose/instance values crisp
+    interp_of = {}
+    for entry in cfg_get(cfg, "input_types", []) or []:
+        for name, props in dict(entry).items():
+            interp_of[name] = str(cfg_get(props, "interpolator",
+                                          "BILINEAR") or "BILINEAR")
+    for t, frames in list(data.items()):
+        if t == "human_instance_maps" or t.endswith("_xy") or \
+                t == "common_attr":
+            continue
+        if not isinstance(frames, (list, tuple)) or not frames or \
+                not hasattr(frames[0], "shape"):
+            continue
+        if np.asarray(frames[0]).shape[:2] != tuple(img_size):
+            continue
+        method = ("nearest" if interp_of.get(t, "").upper() == "NEAREST"
+                  else "bilinear")
+        (cropped,) = crop_and_resize([np.stack(
+            [np.asarray(f) for f in frames])], crop_coords, (h, w),
+            method=method)
+        data[t] = list(cropped)
+    # co-transform the stashed keypoint coordinates (pixel xy in the
+    # leading two columns) so downstream region crops stay aligned
+    sy, sx = h / max(y1 - y0, 1), w / max(x1 - x0, 1)
+    for t in list(data.keys()):
+        if t.endswith("_xy") and hasattr(data[t], "shape"):
+            pts = np.array(data[t], np.float32, copy=True)
+            if pts.shape[-1] >= 2:
+                pts[..., 0] = (pts[..., 0] - x0) * sx
+                pts[..., 1] = (pts[..., 1] - y0) * sy
+                data[t] = pts
+    data.pop("human_instance_maps", None)
+    if is_inference:
+        data.setdefault("common_attr", {})["crop_coords"] = crop_coords
     return data
 
 

@@ -83,3 +83,31 @@ def guidance_tensor(renderer, point_info, w, h, flipped=False):
     image = image.astype(np.float32) / 255.0 * 2.0 - 1.0
     mask = mask.astype(np.float32) / 255.0
     return np.concatenate([image, mask], axis=-1)
+
+
+def decode_unprojections(data):
+    """Unpickle per-frame pixel->point mappings into
+    ``{resolution: (T, N, 3) int array}``
+    (ref: model_utils/wc_vid2vid/render.py:150-199). Each frame pickles
+    ``{resolution: flat [i, j, point_idx, ...] list}``; frames are
+    right-padded with -1 rows to the longest mapping and terminated with
+    a ``(n, n, n)`` sentinel row carrying the real row count, so the
+    consumer (trainers/wc_vid2vid.py::_point_info) can strip the padding
+    after stacking. Registered as the ``convert::`` post_aug_op for the
+    ``unprojections`` pkl data type."""
+    import pickle
+
+    decoded = [pickle.loads(item) for item in data]
+    resolutions = sorted({r for info in decoded for r in info})
+    # every resolution gets an entry for EVERY frame (an empty mapping
+    # when the writer omitted the key), so stack index t stays frame t
+    per_res = {r: [list(info.get(r) or []) for info in decoded]
+               for r in resolutions}
+    outputs = {}
+    for resolution, frames in per_res.items():
+        max_len = max((len(v) for v in frames), default=0)
+        padded = [v + [-1] * (max_len - len(v)) + [len(v) // 3] * 3
+                  for v in frames]
+        outputs[resolution] = np.stack(
+            [np.asarray(p, np.int64).reshape(-1, 3) for p in padded])
+    return outputs

@@ -27,6 +27,34 @@ class Trainer(Vid2VidTrainer):
         self.renderers = {}  # per batch element
         self.is_flipped_input = False
 
+    def _init_loss(self, cfg):
+        """vid2vid losses plus the guidance term: masked L1 between the
+        generated frame and the splat-rendered guidance colors
+        (ref: trainers/wc_vid2vid.py:43-47, MaskedL1Loss
+        normalize_over_valid)."""
+        super()._init_loss(cfg)
+        lw = cfg.trainer.loss_weight
+        if cfg_get(lw, "guidance", None) is not None:
+            self.weights["Guidance"] = lw.guidance
+
+    def gen_forward(self, vars_G, vars_D, loss_params, data, rng,
+                    training=True):
+        losses, new_mut, out = super().gen_forward(
+            vars_G, vars_D, loss_params, data, rng, training)
+        if "Guidance" in self.weights:
+            from imaginaire_tpu.losses.flow import masked_l1_loss
+
+            guidance = data.get("guidance")
+            if guidance is not None:
+                losses["Guidance"] = masked_l1_loss(
+                    out["fake_images"], guidance[..., :3],
+                    guidance[..., 3:], normalize_over_valid=True)
+            else:
+                import jax.numpy as jnp
+
+                losses["Guidance"] = jnp.zeros(())
+        return losses, new_mut, out
+
     def reset_renderer(self, is_flipped_input=False):
         """(ref: generators/wc_vid2vid.py:72-80)."""
         self.renderers = {}
@@ -37,41 +65,94 @@ class Trainer(Vid2VidTrainer):
             self.renderers[b] = SplatRenderer()
         return self.renderers[b]
 
-    def _point_info(self, data, t, b):
+    @staticmethod
+    def _finest_resolution(mapping, target_hw=None):
+        """Pick the '<H>x<W>' entry matching ``target_hw`` when present
+        (its pixel coordinates index the guidance canvas of exactly that
+        size), else the finest (string max would sort '64x64' above
+        '256x256'); None when the window recorded no mappings at all."""
+        if not mapping:
+            return None
+        if target_hw is not None:
+            key = f"{target_hw[0]}x{target_hw[1]}"
+            if key in mapping:
+                return mapping[key]
+
+        def pixel_count(key):
+            try:
+                h, w = str(key).lower().split("x")
+                return int(h) * int(w)
+            except ValueError:
+                return -1
+
+        return mapping[max(mapping.keys(), key=pixel_count)]
+
+    def _point_info(self, data, t, b, target_hw=None):
         """Per-sample (N, 3) pixel->point mapping for frame t, or None.
-        Accepts a nested [batch][frame] list or a stacked (B, T, N, 3)
-        array (the device-upload path converts uniform lists to arrays)."""
+
+        Accepted forms:
+        - nested [batch][frame] list of raw (N, 3) arrays, or a stacked
+          (B, T, N, 3) array (the device-upload path converts uniform
+          lists to arrays);
+        - the ``decode_unprojections`` output ``{resolution: (T, N, 3)}``
+          for a single sample (b must be 0);
+        - what the DataLoader collation makes of it: a list of such
+          per-sample dicts, or a dict of (B, T, N, 3) stacks.
+        Decoded mappings pick the resolution matching ``target_hw`` (the
+        guidance canvas size) when present, else the finest, and strip
+        the -1 padding via the count sentinel row
+        (model_utils/wc_vid2vid.py::decode_unprojections)."""
         unproj = data.get("unprojection")
         if unproj is None:
+            unproj = data.get("unprojections")
+        if unproj is None:
             return None
-        entry = unproj[b]
+
+        decoded = False
+        if isinstance(unproj, dict):
+            unproj = self._finest_resolution(unproj, target_hw)
+            decoded = True
+            if hasattr(unproj, "ndim") and unproj.ndim == 4:
+                entry = unproj[b]  # {res: (B, T, N, 3)}
+            elif b == 0:
+                entry = unproj  # single-sample {res: (T, N, 3)}
+            else:
+                return None  # no mapping recorded for this sample
+        else:
+            entry = unproj[b]
+            if isinstance(entry, dict):  # collated list of sample dicts
+                entry = self._finest_resolution(entry, target_hw)
+                decoded = True
+
         if isinstance(entry, (list, tuple)):
             entry = entry[t] if t < len(entry) else None
         elif hasattr(entry, "ndim") and entry.ndim >= 3:
             entry = entry[t] if t < entry.shape[0] else None
         if entry is None:
             return None
-        return np.asarray(entry)
+        entry = np.asarray(entry)
+        if decoded and entry.ndim == 2 and entry.shape[0]:
+            n = int(entry[-1, 0])
+            entry = entry[:max(n, 0)]
+        return entry
 
     def _get_data_t(self, data, t, prev_labels, prev_images):
         data_t = super()._get_data_t(data, t, prev_labels, prev_images)
         label = data_t["label"]
         b, h, w, _ = label.shape
         guidance = []
-        any_guidance = False
-        for bi in range(b):
-            info = self._point_info(data, t, bi)
+        infos = [self._point_info(data, t, bi, target_hw=(h, w))
+                 for bi in range(b)]
+        for bi, info in enumerate(infos):
             if info is not None:
-                any_guidance = True
                 guidance.append(guidance_tensor(
                     self._renderer(bi), info, w, h,
                     flipped=self.is_flipped_input))
             else:
                 guidance.append(np.zeros((h, w, 4), np.float32))
-        if any_guidance:
+        if any(info is not None for info in infos):
             data_t["guidance"] = np.stack(guidance)
-            data_t["_point_infos"] = [self._point_info(data, t, bi)
-                                      for bi in range(b)]
+            data_t["_point_infos"] = infos
         return data_t
 
     def gen_update(self, data):

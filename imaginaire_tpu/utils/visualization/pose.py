@@ -200,12 +200,20 @@ def draw_openpose_npy(resize_h, resize_w, crop_h, crop_w, original_h,
         if frame is None:
             outputs.append(np.zeros((resize_h, resize_w, c), np.float32))
             continue
-        pts = extract_valid_keypoints(frame, edge_lists)
-        # keypoints were already co-transformed (resize/crop/flip) by the
-        # augmentor — they arrive in canvas coordinates; rescaling again
-        # (as the reference does for raw keypoints) would misalign them
-        label = connect_pose_keypoints(
-            pts, edge_lists, (resize_h, resize_w, c), basic_points_only,
-            remove_face_labels, random_drop_prob)
-        outputs.append(label.astype(np.float32) / 255.0)
+        # multi-person frames (openpose_to_npy without largest-only) are
+        # lists of person dicts: render every person onto one canvas
+        # (ref: pose.py draws per person and maxes the maps)
+        people = frame if isinstance(frame, list) else [frame]
+        label = np.zeros((resize_h, resize_w, c), np.float32)
+        for person in people:
+            pts = extract_valid_keypoints(person, edge_lists)
+            # keypoints were already co-transformed (resize/crop/flip) by
+            # the augmentor — they arrive in canvas coordinates; rescaling
+            # again (as the reference does for raw keypoints) would
+            # misalign them
+            one = connect_pose_keypoints(
+                pts, edge_lists, (resize_h, resize_w, c), basic_points_only,
+                remove_face_labels, random_drop_prob)
+            label = np.maximum(label, one.astype(np.float32) / 255.0)
+        outputs.append(label)
     return outputs

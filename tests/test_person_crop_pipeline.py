@@ -1,0 +1,283 @@
+"""End-to-end dataset-pipeline coverage for the pose/person-crop and
+unprojection data paths the full-scale configs use
+(configs/projects/fs_vid2vid/YouTubeDancing/bf16.yaml,
+wc_vid2vid/mannequin/hed_bf16.yaml):
+
+- crop_person_from_data as a real ``full_data_ops`` entry: runs at the
+  per-type stage of data/base.py::process_item, crops every modality to
+  the DensePose person bbox and consumes the instance maps;
+- an ``ext: pkl`` unprojections type flows through augmentation
+  untouched, is decoded by its convert:: op, and survives the per-type
+  loop as a structured payload.
+"""
+
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from imaginaire_tpu.config import Config
+from imaginaire_tpu.registry import resolve
+
+cv2 = pytest.importorskip("cv2")
+
+
+def _write_pose_fixture(root, t=3, h=96, w=128):
+    """images + densepose pose maps + openpose json + instance maps."""
+    for dtype in ("images", "pose_maps-densepose", "poses-openpose",
+                  "human_instance_maps"):
+        os.makedirs(os.path.join(root, dtype, "seq0"), exist_ok=True)
+    rng = np.random.RandomState(0)
+    for i in range(t):
+        img = rng.randint(0, 255, (h, w, 3), np.uint8)
+        cv2.imwrite(os.path.join(root, "images", "seq0", f"{i:05d}.jpg"), img)
+        dp = np.zeros((h, w, 3), np.uint8)
+        dp[30:70, 40:80] = 120  # the person's densepose support
+        cv2.imwrite(os.path.join(root, "pose_maps-densepose", "seq0",
+                                 f"{i:05d}.png"), dp)
+        inst = np.zeros((h, w, 3), np.uint8)
+        inst[30:70, 40:80, 2] = 1  # instance id 1 (BGR write -> R channel)
+        cv2.imwrite(os.path.join(root, "human_instance_maps", "seq0",
+                                 f"{i:05d}.png"), inst)
+        joints = []
+        for j in range(25):  # full BODY_25 skeleton inside the person box
+            joints += [45.0 + (j % 5) * 7 + i, 32.0 + (j // 5) * 8, 0.9]
+        people = {"people": [{"pose_keypoints_2d": joints}]}
+        with open(os.path.join(root, "poses-openpose", "seq0",
+                               f"{i:05d}.json"), "w") as f:
+            json.dump(people, f)
+
+
+def _pose_cfg(root):
+    cfg = Config()
+    cfg.data = {
+        "name": "person_crop_test",
+        "type": "imaginaire_tpu.data.paired_videos",
+        "num_frames_G": 3,
+        "num_frames_D": 3,
+        "num_workers": 0,
+        "for_pose_dataset": {"pose_type": "both",
+                             "remove_face_labels": False,
+                             "basic_points_only": False,
+                             "random_drop_prob": 0.0},
+        "input_types": [
+            {"images": {"ext": "jpg", "num_channels": 3,
+                        "interpolator": "BILINEAR", "normalize": True}},
+            {"pose_maps-densepose": {"ext": "png", "num_channels": 3,
+                                     "interpolator": "NEAREST",
+                                     "normalize": False}},
+            {"poses-openpose": {
+                "ext": "json", "num_channels": 3,
+                "interpolator": "NEAREST", "normalize": False,
+                "pre_aug_ops": "decode_json, convert::imaginaire_tpu.utils."
+                               "visualization.pose::openpose_to_npy",
+                "post_aug_ops": "vis::imaginaire_tpu.utils."
+                                "visualization.pose::draw_openpose_npy"}},
+            {"human_instance_maps": {"ext": "png", "num_channels": 3,
+                                     "interpolator": "NEAREST",
+                                     "normalize": False}},
+        ],
+        "full_data_ops": "imaginaire_tpu.model_utils."
+                         "fs_vid2vid::crop_person_from_data",
+        "input_image": ["images"],
+        "input_labels": ["pose_maps-densepose", "poses-openpose"],
+        "keypoint_data_types": ["poses-openpose"],
+        "output_h_w": "64, 32",
+        "train": {"roots": [root], "batch_size": 1,
+                  "initial_sequence_length": 3,
+                  "augmentations": {"resize_h_w": "96, 128",
+                                    "horizontal_flip": False}},
+        "val": {"roots": [root], "batch_size": 1,
+                "augmentations": {"resize_h_w": "96, 128",
+                                  "horizontal_flip": False}},
+    }
+    return cfg
+
+
+class TestPersonCropThroughPipeline:
+    def test_item_cropped_to_output_hw(self, tmp_path):
+        root = str(tmp_path / "raw")
+        _write_pose_fixture(root)
+        cfg = _pose_cfg(root)
+        ds = resolve(cfg.data.type, "Dataset")(cfg)
+        item = ds[0]
+        # every modality cropped to output_h_w, instance maps consumed
+        assert item["images"].shape == (3, 64, 32, 3)
+        assert item["label"].shape == (3, 64, 32, 6)  # densepose+openpose
+        assert "human_instance_maps" not in item
+        # the densepose support survived the crop (the bbox centered it)
+        dp = item["label"][..., :3]
+        assert float(np.abs(dp).max()) > 0
+        # multi-person keypoint lists are structured, so no flat '_xy'
+        # stash exists (only flat keypoint arrays stash; the rendered
+        # maps above carry the pose)
+        assert "poses-openpose_xy" not in item
+
+
+class TestUnprojectionsThroughPipeline:
+    def test_pkl_type_decodes_to_structured_payload(self, tmp_path):
+        root = str(tmp_path / "raw")
+        for dtype in ("images", "unprojections"):
+            os.makedirs(os.path.join(root, dtype, "seq0"), exist_ok=True)
+        rng = np.random.RandomState(0)
+        for i in range(3):
+            cv2.imwrite(os.path.join(root, "images", "seq0", f"{i:05d}.jpg"),
+                        rng.randint(0, 255, (64, 64, 3), np.uint8))
+            mapping = {"64x64": [i, i + 1, 7 + i]}  # one (y, x, idx) row
+            with open(os.path.join(root, "unprojections", "seq0",
+                                   f"{i:05d}.pkl"), "wb") as f:
+                f.write(pickle.dumps(mapping))
+        cfg = Config()
+        cfg.data = {
+            "name": "unproj_test",
+            "type": "imaginaire_tpu.data.paired_videos",
+            "num_frames_G": 3, "num_frames_D": 3, "num_workers": 0,
+            "input_types": [
+                {"images": {"ext": "jpg", "num_channels": 3,
+                            "interpolator": "BILINEAR", "normalize": True}},
+                {"unprojections": {
+                    "ext": "pkl",
+                    "post_aug_ops": "convert::imaginaire_tpu.model_utils."
+                                    "wc_vid2vid::decode_unprojections"}},
+            ],
+            "input_image": ["images"],
+            "input_labels": [],
+            "train": {"roots": [root], "batch_size": 1,
+                      "initial_sequence_length": 3,
+                      "augmentations": {"resize_h_w": "64, 64",
+                                        "horizontal_flip": False}},
+            "val": {"roots": [root], "batch_size": 1,
+                    "augmentations": {"resize_h_w": "64, 64",
+                                      "horizontal_flip": False}},
+        }
+        ds = resolve(cfg.data.type, "Dataset")(cfg)
+        item = ds[0]
+        assert item["images"].shape == (3, 64, 64, 3)
+        unproj = item["unprojections"]
+        assert isinstance(unproj, dict) and "64x64" in unproj
+        arr = unproj["64x64"]
+        assert arr.shape == (3, 2, 3)  # 1 row + sentinel per frame
+        # the wc trainer consumes exactly this form
+        from imaginaire_tpu.trainers.wc_vid2vid import Trainer as WcTrainer
+
+        info = WcTrainer._finest_resolution(unproj)
+        assert info.shape == (3, 2, 3)
+
+
+class TestPersonCropGeometry:
+    def test_bbox_clamped_and_xy_consistent(self, tmp_path):
+        """A wide person (width-driven bbox branch) must not overrun the
+        frame; the keypoint rescale shares the clamped geometry."""
+        from imaginaire_tpu.model_utils.fs_vid2vid import crop_person_from_data
+
+        rng = np.random.RandomState(0)
+        t, h, w = 1, 64, 256
+        dp = [np.zeros((h, w, 3), np.float32) for _ in range(t)]
+        dp[0][20:40, 10:250] = 0.8  # arms spread nearly frame-wide
+        data = {"pose_maps-densepose": dp,
+                "images": [rng.rand(h, w, 3).astype(np.float32)],
+                "poses-openpose_xy": np.asarray([[[30.0, 30.0, 0.9]]])}
+        out = crop_person_from_data({"output_h_w": "64, 32"}, True, dict(data))
+        assert out["images"][0].shape == (64, 32, 3)
+        y0, y1, x0, x1 = out["common_attr"]["crop_coords"]
+        assert 0 <= y0 < y1 <= h and 0 <= x0 < x1 <= w
+        # the keypoint moved into the crop frame under the SAME geometry
+        kp = out["poses-openpose_xy"][0, 0]
+        assert 0 <= kp[1] <= 64
+
+    def test_train_jitter_seedable(self):
+        from imaginaire_tpu.model_utils.fs_vid2vid import crop_person_from_data
+
+        rng = np.random.RandomState(0)
+        dp = [np.zeros((64, 64, 3), np.float32)]
+        dp[0][20:50, 20:50] = 0.5
+        base = {"pose_maps-densepose": dp,
+                "images": [rng.rand(64, 64, 3).astype(np.float32)]}
+        a = crop_person_from_data({"output_h_w": "32, 32"}, False, dict(base),
+                                  rng=np.random.RandomState(7))
+        b = crop_person_from_data({"output_h_w": "32, 32"}, False, dict(base),
+                                  rng=np.random.RandomState(7))
+        np.testing.assert_array_equal(a["images"][0], b["images"][0])
+
+    def test_inference_common_attr_threads_between_windows(self, tmp_path):
+        """Later windows of a pinned inference sequence reuse the first
+        window's crop bbox via the dataset-threaded common_attr."""
+        root = str(tmp_path / "raw")
+        _write_pose_fixture(root, t=3)
+        cfg = _pose_cfg(root)
+        ds = resolve(cfg.data.type, "Dataset")(cfg, is_inference=True)
+        ds.set_inference_sequence_idx(0)
+        ds[0]
+        first = dict(ds._common_attr)
+        ds[1]
+        assert ds._common_attr == first  # window 2 reused, not recomputed
+        ds.set_inference_sequence_idx(0)
+        assert ds._common_attr is None  # new sequence -> fresh bbox
+
+
+class TestDecodeAlignment:
+    def test_missing_resolution_keeps_frame_index(self):
+        from imaginaire_tpu.model_utils.wc_vid2vid import decode_unprojections
+
+        frames = [pickle.dumps({"8x8": [0, 0, 1], "4x4": [1, 1, 2]}),
+                  pickle.dumps({"8x8": [2, 2, 3]}),  # no coarse entry
+                  pickle.dumps({"8x8": [3, 3, 4], "4x4": [2, 2, 5]})]
+        out = decode_unprojections(frames)
+        assert out["8x8"].shape[0] == 3 and out["4x4"].shape[0] == 3
+        # frame 1 of the 4x4 stack is an EMPTY mapping, frame 2 kept its
+        # own data (no index shift)
+        assert out["4x4"][1, -1].tolist() == [0, 0, 0]
+        assert out["4x4"][2, 0].tolist() == [2, 2, 5]
+
+
+class TestFewShotRefIsolation:
+    def test_empty_decoded_mapping_is_none(self):
+        from imaginaire_tpu.trainers.wc_vid2vid import Trainer as WcTrainer
+
+        assert WcTrainer._finest_resolution({}) is None
+
+    def test_ref_window_does_not_inherit_driving_crop(self, tmp_path):
+        """process_item(thread_common_attr=False) neither reads nor
+        writes the sequence-level stash (the few-shot ref window's bbox
+        is its own, ref: fs_vid2vid.py:242-256)."""
+        root = str(tmp_path / "raw")
+        _write_pose_fixture(root, t=3)
+        cfg = _pose_cfg(root)
+        ds = resolve(cfg.data.type, "Dataset")(cfg, is_inference=True)
+        ds.set_inference_sequence_idx(0)
+        ds[0]
+        stashed = dict(ds._common_attr)
+        raw = ds.load_item(*ds._item_spec(1)) if hasattr(ds, "_item_spec") \
+            else None
+        # drive process_item directly with the flag: stash untouched
+        if raw is None:
+            raw = {t: [np.zeros((96, 128, 3), np.uint8)]
+                   for t in ("images", "pose_maps-densepose",
+                             "human_instance_maps")}
+            raw["poses-openpose"] = [b'{"people": []}']
+        ds.process_item({k: list(v) for k, v in raw.items()},
+                        thread_common_attr=False)
+        assert ds._common_attr == stashed
+
+
+class TestResolutionSelection:
+    def test_target_hw_beats_finest(self):
+        from imaginaire_tpu.trainers.wc_vid2vid import Trainer as WcTrainer
+
+        m = {"256x512": "fine", "64x128": "match"}
+        assert WcTrainer._finest_resolution(m, (64, 128)) == "match"
+        assert WcTrainer._finest_resolution(m) == "fine"
+        assert WcTrainer._finest_resolution(m, (1, 1)) == "fine"  # fallback
+
+    def test_nearest_interp_preserves_discrete_labels(self):
+        from imaginaire_tpu.model_utils.fs_vid2vid import crop_and_resize
+
+        f = np.zeros((1, 32, 32, 3), np.float32)
+        f[0, :16] = 7.0
+        (near,) = crop_and_resize([f], [0, 32, 0, 32], (48, 48),
+                                  method="nearest")
+        assert set(np.unique(near)) <= {0.0, 7.0}  # no blended values
+        (lin,) = crop_and_resize([f], [0, 32, 0, 32], (48, 48))
+        assert len(np.unique(lin)) > 2  # bilinear blends the boundary

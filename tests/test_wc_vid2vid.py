@@ -106,3 +106,70 @@ class TestWcVid2VidTraining:
         g = trainer.gen_update(batch)
         for name, v in g.items():
             assert np.isfinite(float(jax.device_get(v))), name
+
+
+class TestDecodeUnprojections:
+    def test_decode_and_point_info_roundtrip(self, rng, tmp_path):
+        """decode_unprojections pads ragged frame mappings with -1 rows
+        plus a count sentinel (ref: render.py:150-199); _point_info
+        strips both and picks the finest resolution."""
+        import pickle
+
+        from imaginaire_tpu.model_utils.wc_vid2vid import decode_unprojections
+
+        f0 = [0, 0, 5, 1, 2, 7]          # 2 mappings
+        f1 = [3, 3, 9]                   # 1 mapping
+        f2 = []                          # none
+        frames = [pickle.dumps({"256x256": f, "64x64": f[:3]})
+                  for f in (f0, f1, f2)]
+        out = decode_unprojections(frames)
+        assert set(out) == {"256x256", "64x64"}
+        arr = out["256x256"]
+        assert arr.shape == (3, 3, 3)  # 2 rows padded + sentinel
+        # frame 0: both rows real, sentinel count 2
+        assert arr[0, 0].tolist() == [0, 0, 5]
+        assert arr[0, -1].tolist() == [2, 2, 2]
+        # frame 1: one real row, one -1 pad, sentinel count 1
+        assert arr[1, 1].tolist() == [-1, -1, -1]
+        assert arr[1, -1].tolist() == [1, 1, 1]
+        assert arr[2, -1].tolist() == [0, 0, 0]
+
+        cfg = Config(CFG)
+        cfg.logdir = str(tmp_path)
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        data = {"unprojections": out}
+        info = trainer._point_info(data, 0, 0)
+        assert info.shape == (2, 3) and info[1].tolist() == [1, 2, 7]
+        info = trainer._point_info(data, 1, 0)
+        assert info.shape == (1, 3) and info[0].tolist() == [3, 3, 9]
+        assert trainer._point_info(data, 2, 0).shape == (0, 3)
+        # single-sample dict has no data for b>0
+        assert trainer._point_info(data, 0, 1) is None
+
+        # the DataLoader collates per-sample dicts into a list of dicts
+        collated = {"unprojections": [out, out]}
+        info = trainer._point_info(collated, 1, 1)
+        assert info.shape == (1, 3) and info[0].tolist() == [3, 3, 9]
+        # ...or stacks uniform arrays into {res: (B, T, N, 3)}
+        stacked = {"unprojections":
+                   {k: np.stack([v, v]) for k, v in out.items()}}
+        info = trainer._point_info(stacked, 0, 1)
+        assert info.shape == (2, 3) and info[0].tolist() == [0, 0, 5]
+
+
+@pytest.mark.slow
+class TestGuidanceLoss:
+    def test_guidance_loss_present_and_finite(self, rng, tmp_path):
+        """loss_weight.guidance turns on the masked-L1 guidance term
+        (ref: trainers/wc_vid2vid.py:43-47)."""
+        cfg = Config(CFG)
+        cfg.logdir = str(tmp_path)
+        cfg.trainer.loss_weight.guidance = 20.0
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        assert trainer.weights["Guidance"] == 20.0
+        trainer.init_state(jax.random.PRNGKey(0), wc_video_batch(rng))
+        batch = trainer.start_of_iteration(wc_video_batch(rng), 1)
+        g = trainer.gen_update(batch)
+        assert "Guidance" in g
+        for name, v in g.items():
+            assert np.isfinite(float(jax.device_get(v))), name
