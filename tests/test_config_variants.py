@@ -239,16 +239,14 @@ def _step_one(rel, rng, tmp_path):
         assert np.isfinite(float(jax.device_get(v))), (rel, name)
 
 
-# one representative per trainer family, biased to the newest configs
-# (hed guidance modality, person-crop pose, patch-wise HD munit,
-# class-305 coco-funit, ring-capable spade-attention)
+# full-width step representatives: the configs whose training paths are
+# NOT already stepped by the per-family unit-config tests — the
+# ring-capable spade-attention variant and the three video configs with
+# new modalities (pose person-crop, hed guidance). The image families'
+# paths run 2-iteration unit configs in their own test files; their
+# full-width steps live in the opt-in projects_full sweep.
 FAMILY_REPS = [
     "spade/cocostuff/base128_bs4_attn.yaml",
-    "pix2pixHD/cityscapes/bf16.yaml",
-    "unit/winter2summer/base48_bs1.yaml",
-    "munit/summer2winter_hd/bf16.yaml",
-    "funit/animal_faces/base64_bs8_class149.yaml",
-    "coco_funit/mammals/base64_bs8_class305.yaml",
     "vid2vid/dancing/bf16.yaml",
     "fs_vid2vid/YouTubeDancing/bf16.yaml",
     "wc_vid2vid/mannequin/hed_bf16.yaml",
