@@ -609,3 +609,104 @@ class TestFlowNet2GoldenVsTorch:
                                        err_msg=name)
         np.testing.assert_allclose(np.asarray(flow), _nhwc(taps["fusion"]),
                                    rtol=1e-4, atol=1e-4, err_msg="fusion")
+
+
+# ---------------------------------------------------------------------------
+# ResNet50 (torchvision trunk, hand-built; the resnet50/robust_resnet50
+# perceptual backbones share this graph — ref: perceptual.py:256-297)
+# ---------------------------------------------------------------------------
+
+
+class TBottleneck(tnn.Module):
+    def __init__(self, in_ch, feats, stride=1, downsample=False):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(in_ch, feats, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(feats)
+        self.conv2 = tnn.Conv2d(feats, feats, 3, stride, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(feats)
+        self.conv3 = tnn.Conv2d(feats, feats * 4, 1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(feats * 4)
+        self.downsample = None
+        if downsample:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(in_ch, feats * 4, 1, stride, bias=False),
+                tnn.BatchNorm2d(feats * 4))
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        y = F.relu(self.bn1(self.conv1(x)))
+        y = F.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return F.relu(y + identity)
+
+
+class TResNet50(tnn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(64)
+        self.maxpool = tnn.MaxPool2d(3, 2, 1)
+        in_ch = 64
+        for li, (blocks, feats) in enumerate(
+                [(3, 64), (4, 128), (6, 256), (3, 512)], start=1):
+            layers = []
+            for bi in range(blocks):
+                stride = 2 if (bi == 0 and li > 1) else 1
+                layers.append(TBottleneck(in_ch, feats, stride,
+                                          downsample=(bi == 0)))
+                in_ch = feats * 4
+            setattr(self, f"layer{li}", tnn.Sequential(*layers))
+
+    def forward(self, x):
+        taps = {}
+        x = self.maxpool(F.relu(self.bn1(self.conv1(x))))
+        for li in range(1, 5):
+            x = getattr(self, f"layer{li}")(x)
+            taps[f"layer_{li}"] = x
+        return taps
+
+
+def _randomize_resnet_bn(module, seed):
+    """Randomize BN running stats AND affines (1-D weight/bias params are
+    always BN here — conv kernels are 4-D): a port that dropped the BN
+    scale/shift entirely must fail the golden."""
+    g = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for name, p in module.state_dict().items():
+            if name.endswith("running_var"):
+                p.copy_(0.5 + torch.rand(p.shape, generator=g))
+            elif name.endswith("running_mean"):
+                p.copy_(0.3 * torch.randn(p.shape, generator=g))
+            elif name.endswith(".weight") and p.ndim == 1:
+                p.copy_(1.0 + 0.2 * torch.randn(p.shape, generator=g))
+            elif name.endswith(".bias") and p.ndim == 1:
+                p.copy_(0.1 * torch.randn(p.shape, generator=g))
+
+
+@pytest.mark.slow
+class TestResNet50GoldenVsTorch:
+    def test_layer_taps_match(self, tmp_path):
+        from imaginaire_tpu.losses.perceptual import (
+            ResNet50Features,
+            load_torch_resnet50_weights,
+        )
+
+        torch.manual_seed(2)
+        tnet = TResNet50().eval()
+        _randomize_resnet_bn(tnet, seed=2)
+        sd = {k: v.numpy() for k, v in tnet.state_dict().items()
+              if not k.endswith("num_batches_tracked")}
+        path = str(tmp_path / "resnet50.npz")
+        np.savez(path, **sd)
+        params = load_torch_resnet50_weights(path)
+
+        capture = ("layer_1", "layer_2", "layer_3", "layer_4")
+        x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
+        x = x * 2.0 - 1.0
+        ours = ResNet50Features(capture=capture).apply(
+            {"params": params}, jnp.asarray(x))
+        with torch.no_grad():
+            taps = tnet(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))))
+        for name in capture:
+            np.testing.assert_allclose(np.asarray(ours[name]), _nhwc(taps[name]),
+                                       rtol=1e-4, atol=1e-4, err_msg=name)
