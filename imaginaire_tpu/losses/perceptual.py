@@ -114,6 +114,25 @@ class AlexNetFeatures(nn.Module):
         return out
 
 
+def _adaptive_avg_pool(x, out_h, out_w):
+    """torch AdaptiveAvgPool2d semantics on NHWC: window i spans
+    [floor(i*H/out), ceil((i+1)*H/out)) — exact for every input size
+    (identity when the input already is (out_h, out_w)). Static window
+    boundaries, so the unrolled means fuse under jit."""
+    b, h, w, c = x.shape
+    if (h, w) == (out_h, out_w):
+        return x
+    rows = []
+    for i in range(out_h):
+        y0, y1 = (i * h) // out_h, -((-(i + 1) * h) // out_h)
+        cols = []
+        for j in range(out_w):
+            x0, x1 = (j * w) // out_w, -((-(j + 1) * w) // out_w)
+            cols.append(jnp.mean(x[:, y0:y1, x0:x1, :], axis=(1, 2)))
+        rows.append(jnp.stack(cols, axis=1))
+    return jnp.stack(rows, axis=1)
+
+
 class VGGFaceFeatures(nn.Module):
     """vgg_face_dag: VGG16 trunk + 7x7 avgpool + fc6/fc7/fc8 classifier
     taps — the only layers the reference exposes for this network
@@ -139,13 +158,11 @@ class VGGFaceFeatures(nn.Module):
             x = nn.relu(nn.Conv(v, (3, 3), padding=1,
                                 name=f"conv_{conv_i}")(x))
             conv_i += 1
-        b, h, w, c = x.shape
-        x = jax.image.resize(x, (b, 7, 7, c), "bilinear") \
-            if (h, w) != (7, 7) else x  # AdaptiveAvgPool2d((7, 7))
+        x = _adaptive_avg_pool(x, 7, 7)  # AdaptiveAvgPool2d((7, 7))
         tap("avgpool", x)
         # torch flattens NCHW -> (B, C*7*7); transpose so ported fc6
         # weights line up
-        x = jnp.transpose(x, (0, 3, 1, 2)).reshape(b, -1)
+        x = jnp.transpose(x, (0, 3, 1, 2)).reshape(x.shape[0], -1)
         x = nn.Dense(4096, name="fc6")(x)
         tap("fc6", x)
         x = nn.relu(x)

@@ -27,20 +27,28 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
 import convert_weights  # noqa: E402
 
 
-def _randomize_bn(module, seed):
+def _randomize_bn(module, seed, affine_by_ndim=False):
     """Random BN affines + running stats (var positive); conv weights keep
     torch's default (already random) init, which both sides share via the
-    converter."""
+    converter. ``affine_by_ndim`` recognizes BN affines as the 1-D
+    weight/bias params (resnet naming: bn1/bn2/downsample.1) instead of
+    the '.bn.' suffix convention."""
     g = torch.Generator().manual_seed(seed)
+
+    def is_affine(name, p, suffix):
+        if affine_by_ndim:
+            return name.endswith("." + suffix) and p.ndim == 1
+        return name.endswith("bn." + suffix)
+
     with torch.no_grad():
         for name, p in module.state_dict().items():
             if name.endswith("running_var"):
                 p.copy_(0.5 + torch.rand(p.shape, generator=g))
             elif name.endswith("running_mean"):
                 p.copy_(0.3 * torch.randn(p.shape, generator=g))
-            elif name.endswith("bn.weight"):
+            elif is_affine(name, p, "weight"):
                 p.copy_(1.0 + 0.2 * torch.randn(p.shape, generator=g))
-            elif name.endswith("bn.bias"):
+            elif is_affine(name, p, "bias"):
                 p.copy_(0.1 * torch.randn(p.shape, generator=g))
 
 
@@ -666,23 +674,6 @@ class TResNet50(tnn.Module):
         return taps
 
 
-def _randomize_resnet_bn(module, seed):
-    """Randomize BN running stats AND affines (1-D weight/bias params are
-    always BN here — conv kernels are 4-D): a port that dropped the BN
-    scale/shift entirely must fail the golden."""
-    g = torch.Generator().manual_seed(seed)
-    with torch.no_grad():
-        for name, p in module.state_dict().items():
-            if name.endswith("running_var"):
-                p.copy_(0.5 + torch.rand(p.shape, generator=g))
-            elif name.endswith("running_mean"):
-                p.copy_(0.3 * torch.randn(p.shape, generator=g))
-            elif name.endswith(".weight") and p.ndim == 1:
-                p.copy_(1.0 + 0.2 * torch.randn(p.shape, generator=g))
-            elif name.endswith(".bias") and p.ndim == 1:
-                p.copy_(0.1 * torch.randn(p.shape, generator=g))
-
-
 @pytest.mark.slow
 class TestResNet50GoldenVsTorch:
     def test_layer_taps_match(self, tmp_path):
@@ -693,7 +684,7 @@ class TestResNet50GoldenVsTorch:
 
         torch.manual_seed(2)
         tnet = TResNet50().eval()
-        _randomize_resnet_bn(tnet, seed=2)
+        _randomize_bn(tnet, seed=2, affine_by_ndim=True)
         sd = {k: v.numpy() for k, v in tnet.state_dict().items()
               if not k.endswith("num_batches_tracked")}
         path = str(tmp_path / "resnet50.npz")
@@ -710,3 +701,85 @@ class TestResNet50GoldenVsTorch:
         for name in capture:
             np.testing.assert_allclose(np.asarray(ours[name]), _nhwc(taps[name]),
                                        rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# vgg_face_dag (VGG16 trunk + fc6/fc7/fc8 classifier; the only layers the
+# reference exposes for this backbone — ref: perceptual.py:299-358)
+# ---------------------------------------------------------------------------
+
+
+class TVGGFaceDag(tnn.Module):
+    """vgg_face_dag-style module whose state_dict names (conv1_1..conv5_3,
+    fc6/fc7/fc8) match what scripts/convert_weights.py::convert_vgg_face_dag
+    consumes."""
+
+    _CONVS = [("conv1_1", 3, 64), ("conv1_2", 64, 64),
+              ("conv2_1", 64, 128), ("conv2_2", 128, 128),
+              ("conv3_1", 128, 256), ("conv3_2", 256, 256),
+              ("conv3_3", 256, 256),
+              ("conv4_1", 256, 512), ("conv4_2", 512, 512),
+              ("conv4_3", 512, 512),
+              ("conv5_1", 512, 512), ("conv5_2", 512, 512),
+              ("conv5_3", 512, 512)]
+    _POOL_AFTER = {"conv1_2", "conv2_2", "conv3_3", "conv4_3", "conv5_3"}
+
+    def __init__(self):
+        super().__init__()
+        for name, i, o in self._CONVS:
+            setattr(self, name, tnn.Conv2d(i, o, 3, padding=1))
+        self.fc6 = tnn.Linear(512 * 7 * 7, 4096)
+        self.fc7 = tnn.Linear(4096, 4096)
+        self.fc8 = tnn.Linear(4096, 2622)
+
+    def forward(self, x):
+        taps = {}
+        for name, _, _ in self._CONVS:
+            x = F.relu(getattr(self, name)(x))
+            if name in self._POOL_AFTER:
+                x = F.max_pool2d(x, 2, 2)
+        x = F.adaptive_avg_pool2d(x, (7, 7))
+        taps["avgpool"] = x
+        x = torch.flatten(x, 1)
+        x = taps["fc6"] = self.fc6(x)
+        x = F.relu(x)
+        x = self.fc7(x)
+        x = taps["relu_7"] = F.relu(x)
+        taps["fc8"] = self.fc8(x)
+        return taps
+
+
+@pytest.mark.slow
+class TestVGGFaceGoldenVsTorch:
+    def test_classifier_taps_match(self, tmp_path):
+        from imaginaire_tpu.losses.perceptual import (
+            VGGFaceFeatures,
+            load_torch_vgg_face_weights,
+        )
+
+        torch.manual_seed(3)
+        tnet = TVGGFaceDag().eval()
+        ckpt = tmp_path / "vgg_face_dag.pth"
+        torch.save(tnet.state_dict(), ckpt)
+        out = str(tmp_path / "vgg_face.npz")
+        convert_weights.convert_vgg_face_dag(out, str(ckpt))
+        params = load_torch_vgg_face_weights(out)
+
+        capture = ("avgpool", "fc6", "relu_7", "fc8")
+        # 224px hits the identity branch of the adaptive pool; 160px
+        # (trunk output 5x5 -> pooled up to 7x7) and 288px (9x9 -> 7x7)
+        # exercise the real AdaptiveAvgPool2d window math
+        for size in (224, 160, 288):
+            x = np.random.RandomState(0).rand(1, size, size, 3)
+            x = x.astype(np.float32)
+            ours = VGGFaceFeatures(capture=capture).apply(
+                {"params": params}, jnp.asarray(x))
+            with torch.no_grad():
+                taps = tnet(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))))
+            np.testing.assert_allclose(
+                np.asarray(ours["avgpool"]), _nhwc(taps["avgpool"]),
+                rtol=1e-4, atol=1e-4, err_msg=f"avgpool@{size}")
+            for name in ("fc6", "relu_7", "fc8"):
+                np.testing.assert_allclose(
+                    np.asarray(ours[name]), taps[name].numpy(),
+                    rtol=1e-3, atol=1e-3, err_msg=f"{name}@{size}")

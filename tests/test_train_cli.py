@@ -36,6 +36,18 @@ def _run_train(config, logdir, max_iter=2):
         env=_test_env())
 
 
+@pytest.fixture(scope="module")
+def spade_checkpoint(tmp_path_factory):
+    """One shared 2-iter spade training run for the evaluate/inference
+    CLI tests (the resume test trains its own logdir — re-invoking
+    train.py there mutates it)."""
+    logdir = str(tmp_path_factory.mktemp("spade_cli") / "log")
+    r = _run_train("spade.yaml", logdir)
+    assert r.returncode == 0, r.stderr[-2000:]
+    with open(os.path.join(logdir, "latest_checkpoint.txt")) as f:
+        return os.path.join(logdir, f.read().strip())
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("config", ["spade.yaml", "vid2vid_street.yaml"])
 def test_train_cli_two_iters_then_resume(config, tmp_path):
@@ -62,20 +74,14 @@ def test_train_cli_bad_config_fails_loudly(tmp_path):
 
 
 @pytest.mark.slow
-def test_evaluate_cli_end_to_end(tmp_path):
+def test_evaluate_cli_end_to_end(spade_checkpoint, tmp_path):
     """train.py 2 iters -> evaluate.py --checkpoint --metrics kid,prdc
     (random-init inception via a derived config), plus the loud failure
     when the metrics can't be produced (no weights, no random_init)."""
     import yaml
 
-    logdir = str(tmp_path / "log")
     base = os.path.join(ROOT, "configs", "unit_test", "spade.yaml")
-    r = _run_train("spade.yaml", logdir)
-    assert r.returncode == 0, r.stderr[-2000:]
-    pointer = glob.glob(os.path.join(logdir, "latest_checkpoint.txt"))
-    assert pointer
-    with open(pointer[0]) as f:
-        ckpt_path = os.path.join(logdir, f.read().strip())
+    ckpt_path = spade_checkpoint
 
     with open(base) as f:
         cfg = yaml.safe_load(f)
@@ -97,13 +103,44 @@ def test_evaluate_cli_end_to_end(tmp_path):
     assert "KID:" in r2.stdout and "PRDC_precision:" in r2.stdout, \
         r2.stdout[-800:]
 
-    # without weights or random_init the sweep must fail loudly (only
-    # meaningful where no converted inception weights are provisioned)
+
+@pytest.mark.slow
+def test_evaluate_cli_fails_loudly_without_weights(spade_checkpoint,
+                                                   tmp_path):
+    """Without converted inception weights or fid_random_init, the sweep
+    must exit non-zero instead of reporting a silent partial result."""
     from imaginaire_tpu.evaluation.inception import DEFAULT_WEIGHTS
 
     if os.path.exists(DEFAULT_WEIGHTS):
         pytest.skip("converted inception weights present: the no-weights "
                     "failure leg is unreachable")
-    r3 = run_eval(base)
-    assert r3.returncode != 0
-    assert "produced none" in (r3.stdout + r3.stderr)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "evaluate.py"),
+         "--config", os.path.join(ROOT, "configs", "unit_test",
+                                  "spade.yaml"),
+         "--logdir", str(tmp_path / "eval"),
+         "--checkpoint", spade_checkpoint, "--metrics", "kid,prdc"],
+        capture_output=True, text=True, cwd=ROOT, timeout=1200,
+        env=_test_env())
+    assert r.returncode != 0
+    assert "produced none" in (r.stdout + r.stderr)
+
+
+@pytest.mark.slow
+def test_inference_cli_end_to_end(spade_checkpoint, tmp_path):
+    """Shared 2-iter checkpoint -> inference.py writes images for every
+    test item (ref: the reference's inference entry contract)."""
+    ckpt_path = spade_checkpoint
+    out_dir = str(tmp_path / "out")
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "inference.py"),
+         "--config", os.path.join(ROOT, "configs", "unit_test", "spade.yaml"),
+         "--checkpoint", ckpt_path, "--output_dir", out_dir,
+         "--logdir", str(tmp_path / "inflog")],
+        capture_output=True, text=True, cwd=ROOT, timeout=1200,
+        env=_test_env())
+    assert r2.returncode == 0, r2.stdout[-500:] + r2.stderr[-1500:]
+    assert "Done with inference" in r2.stdout
+    images = [f for dp, _, fs in os.walk(out_dir)
+              for f in fs if f.endswith((".jpg", ".png"))]
+    assert images, f"no images written under {out_dir}"
