@@ -33,7 +33,7 @@ from imaginaire_tpu.utils.misc import upsample_2x
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.layers import Conv2dBlock, LinearBlock, Res2dBlock
 from imaginaire_tpu.utils.data import (
-    get_crop_h_w,
+    get_crop_or_resize_h_w,
     get_paired_input_image_channel_number,
     get_paired_input_label_channel_number,
 )
@@ -52,7 +52,10 @@ class Generator(nn.Module):
         data_cfg = as_attrdict(self.data_cfg)
         image_channels = get_paired_input_image_channel_number(data_cfg)
         num_labels = get_paired_input_label_channel_number(data_cfg)
-        crop_h, crop_w = get_crop_h_w(data_cfg.train.augmentations)
+        # crop size when cropping, else the fixed resize (crop-free
+        # configs like the wc-mannequin hed_single pretrain stage — the
+        # reference's spade_v2 handles those)
+        crop_h, crop_w = get_crop_or_resize_h_w(data_cfg.train.augmentations)
         out_small_side = min(crop_h, crop_w)
 
         num_filters = cfg_get(gen_cfg, "num_filters", 128)

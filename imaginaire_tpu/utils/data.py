@@ -62,3 +62,22 @@ def get_crop_h_w(augmentation):
             crop_h, crop_w = str(augmentation[k]).split(",")
             return int(crop_h), int(crop_w)
     raise AttributeError("no *crop_h_w augmentation in config")
+
+
+def get_crop_or_resize_h_w(augmentation):
+    """Output size of the augmentation pipeline: the '*crop_h_w' key when
+    one exists, else the fixed 'resize_h_w' (crop-free configs like the
+    wc-mannequin hed stages). Raises an actionable ValueError when
+    neither key can size the model."""
+    augmentation = as_attrdict(augmentation)
+    try:
+        return get_crop_h_w(augmentation)
+    except AttributeError:
+        resize = cfg_get(augmentation, "resize_h_w", None)
+        if resize is None:
+            raise ValueError(
+                "augmentations must carry a '*crop_h_w' or 'resize_h_w' "
+                f"entry to size the model; got {sorted(augmentation)}"
+            ) from None
+        h, w = str(resize).split(",")
+        return int(h), int(w)

@@ -168,7 +168,9 @@ def _project_batch(cfg, rng):
                 "labels_content": jnp.asarray([0], jnp.int32),
                 "labels_style": jnp.asarray([1], jnp.int32)}
     if t.endswith(("munit", "unit")):
-        return {"images_a": img(1, 64, 64), "images_b": img(1, 64, 64)}
+        # 256px (the configs' real crop): munit's 6 stride-2 residual
+        # blocks plus the kernel-4 VALID aggregation underflow below that
+        return {"images_a": img(1, 256, 256), "images_b": img(1, 256, 256)}
     n = _label_channels(cfg)
     if t.endswith("fs_vid2vid"):
         label = (rng.rand(1, 64, 64, n) > 0.9).astype(np.float32)
