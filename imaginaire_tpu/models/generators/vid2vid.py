@@ -121,12 +121,14 @@ class Generator(nn.Module):
 
         aug = cfg_get(cfg_get(data_cfg, "val", {}) or {}, "augmentations",
                       {}) or {}
-        crop_h_w = cfg_get(aug, "center_crop_h_w", None) or \
-            cfg_get(aug, "resize_h_w", None)
-        if crop_h_w is None:
-            raise ValueError("Need data.val.augmentations center_crop_h_w or "
-                             "resize_h_w to size the generator bottleneck.")
-        crop_h, crop_w = [int(v) for v in str(crop_h_w).split(",")]
+        from imaginaire_tpu.utils.data import get_crop_or_resize_h_w
+
+        try:
+            crop_h, crop_w = get_crop_or_resize_h_w(aug)
+        except ValueError:
+            raise ValueError(
+                "Need data.val.augmentations center_crop_h_w or resize_h_w "
+                "to size the generator bottleneck.") from None
         self.sh = crop_h // (2 ** self.num_layers)
         self.sw = crop_w // (2 ** self.num_layers)
 

@@ -27,12 +27,15 @@ class Trainer(BaseTrainer):
         super().__init__(cfg, *args, **kwargs)
         self.video_mode = str(cfg_get(cfg.data, "type", "")).endswith("paired_videos")
         try:
-            from imaginaire_tpu.utils.data import get_crop_h_w
+            from imaginaire_tpu.utils.data import get_crop_or_resize_h_w
 
-            crop_h, crop_w = get_crop_h_w(cfg.data.train.augmentations)
+            # same crop-else-resize sizing the generator uses — trainer
+            # input rounding and the generator ladder must agree on base
+            crop_h, crop_w = get_crop_or_resize_h_w(
+                cfg.data.train.augmentations)
             self.base = {256: 16, 512: 32, 1024: 64}.get(min(crop_h, crop_w), 32)
-        except (AttributeError, KeyError):
-            self.base = 32
+        except (AttributeError, KeyError, ValueError):
+            self.base = 32  # size-less config: tests feed 256-class inputs
 
     def _init_loss(self, cfg):
         """(ref: trainers/spade.py:56-81)."""
