@@ -105,6 +105,18 @@ class BaseDataset:
         aug_cfg = cfg_get(data_info, "augmentations", None) or {}
         self.augmentor = Augmentor(aug_cfg, self.interpolators,
                                    keypoint_data_types=self.keypoint_data_types)
+        if self.augmentor.max_time_step > 1 and not self.supports_temporal_stride:
+            # the knob must never parse without effect: silently accepting
+            # it would change training semantics vs the reference
+            # (ref: datasets/paired_videos.py:167-191)
+            raise ValueError(
+                f"augmentations.max_time_step={self.augmentor.max_time_step} "
+                f"is configured, but {type(self).__module__} does not "
+                "implement strided temporal sampling; use a video dataset "
+                "type or drop the knob")
+
+    # video subclasses honoring augmentations.max_time_step set this True
+    supports_temporal_stride = False
 
     # ------------------------------------------------------------------ api
 

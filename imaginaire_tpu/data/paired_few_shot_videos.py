@@ -25,6 +25,13 @@ class Dataset(VideoDataset):
         self.inference_sequence_idx = 0
         self.inference_k_shot_sequence_index = 0
         self.inference_k_shot_frame_index = 0
+        if is_inference:
+            # the default sequence (idx 0) is pinned without any
+            # set_inference_sequence_idx call — it needs the first-frame
+            # crop barrier too
+            import threading
+
+            self._first_item_event = threading.Event()
         self._rebuild()
 
     def set_inference_sequence_idx(self, index, k_shot_index=None,
@@ -40,6 +47,9 @@ class Dataset(VideoDataset):
         # a new sequence must not inherit the previous one's
         # threaded common attributes (e.g. the person-crop bbox)
         self._common_attr = None
+        import threading
+
+        self._first_item_event = threading.Event()
 
     def set_few_shot_K(self, k):
         self.few_shot_K = int(k)
@@ -52,27 +62,41 @@ class Dataset(VideoDataset):
         self.epoch_length = max(len(self.valid), 1)
 
     def __getitem__(self, index):
+        frame_idx = None
         if self.is_inference:
             root_idx, seq, stems = self.sequences[self.inference_sequence_idx]
-            frames = [stems[index % len(stems)]]
+            frame_idx = index % len(stems)
+            frames = [stems[frame_idx]]
+            self._await_first_frame(frame_idx)
             ref_root, ref_seq, ref_stems = self.sequences[
                 self.inference_k_shot_sequence_index]
             ref_frames = [ref_stems[self.inference_k_shot_frame_index
                                     % len(ref_stems)]]
         else:
-            root_idx, seq, stems = self.valid[index % len(self.valid)]
-            max_start = len(stems) - self.sequence_length - self.few_shot_K
+            # strided window; the K refs must fit outside it
+            # (ref: paired_few_shot_videos.py:150-179)
+            required, time_step = self._sample_time_step(
+                extra=self.few_shot_K)
+            candidates = (self.valid if time_step == 1 else
+                          [s for s in self.valid
+                           if len(s[2]) >= required + self.few_shot_K])
+            root_idx, seq, stems = candidates[index % len(candidates)]
+            max_start = len(stems) - required - self.few_shot_K
             start = random.randint(0, max(max_start, 0))
-            frames = stems[start:start + self.sequence_length]
+            end = start + required
+            frames = stems[start:end:time_step]
+            assert len(frames) == self.sequence_length
             # K reference frames disjoint from the window
-            pool = list(range(0, start)) + list(
-                range(start + self.sequence_length, len(stems)))
+            pool = list(range(0, start)) + list(range(end, len(stems)))
             ref_frames = [stems[i] for i in
                           sorted(random.sample(pool, self.few_shot_K))]
             ref_root, ref_seq = root_idx, seq
 
-        raw = self.load_item(root_idx, seq, frames)
-        out = self.process_item(raw)
+        try:
+            raw = self.load_item(root_idx, seq, frames)
+            out = self.process_item(raw)
+        finally:
+            self._signal_first_frame(frame_idx)
         out = self.concat_labels(out)
         ref_raw = self.load_item(ref_root, ref_seq, ref_frames)
         # the reference window computes its OWN person bbox — it must not

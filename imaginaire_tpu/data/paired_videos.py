@@ -12,6 +12,7 @@ start offset. Output tensors are (T, H, W, C); the loader collates to
 from __future__ import annotations
 
 import random
+import threading
 
 import numpy as np
 
@@ -20,6 +21,8 @@ from imaginaire_tpu.data.base import BaseDataset
 
 
 class Dataset(BaseDataset):
+    supports_temporal_stride = True
+
     def __init__(self, cfg, is_inference=False, is_test=False):
         super().__init__(cfg, is_inference, is_test)
         self.sequence_length = int(
@@ -61,6 +64,11 @@ class Dataset(BaseDataset):
         # a new sequence must not inherit the previous one's
         # threaded common attributes (e.g. the person-crop bbox)
         self._common_attr = None
+        # prefetch workers processing frames >0 block on this until
+        # frame 0 has stashed the sequence's common attrs — otherwise
+        # the first prefetched window computes its own crop and the
+        # rollout's first frames jitter
+        self._first_item_event = threading.Event()
 
     def _rebuild(self):
         self.valid = [s for s in self.sequences
@@ -70,21 +78,69 @@ class Dataset(BaseDataset):
     def __len__(self):
         return self.epoch_length
 
+    def _sample_time_step(self, extra=0):
+        """Temporal-stride augmentation: a random frame stride in
+        [1, max_time_step], falling back to 1 when the strided window
+        (plus ``extra`` frames, e.g. few-shot refs) exceeds even the
+        longest sequence (ref: paired_videos.py:167-177,
+        utils/data.py:111-114)."""
+        time_step = random.randint(1, self.augmentor.max_time_step)
+        required = 1 + (self.sequence_length - 1) * time_step
+        if required + extra > self.sequence_length_max:
+            required, time_step = self.sequence_length, 1
+        return required, time_step
+
     def __getitem__(self, index):
         seq_idx = getattr(self, "inference_sequence_idx", None)
         if self.is_inference and seq_idx is not None:
             # pinned sequence: item = one frame (ref: paired_videos.py:150+)
             root_idx, seq, stems = self.sequences[seq_idx]
-            frames = [stems[index % len(stems)]]
+            frame_idx = index % len(stems)
+            frames = [stems[frame_idx]]
+            self._await_first_frame(frame_idx)
         else:
-            root_idx, seq, stems = self.valid[index % len(self.valid)]
-            max_start = len(stems) - self.sequence_length
+            if self.is_inference:
+                required, time_step = self.sequence_length, 1
+            else:
+                required, time_step = self._sample_time_step()
+            # stride > 1 needs a longer raw window than self.valid
+            # guarantees (ref: paired_videos.py:178-182)
+            candidates = (self.valid if time_step == 1 else
+                          [s for s in self.valid if len(s[2]) >= required])
+            root_idx, seq, stems = candidates[index % len(candidates)]
+            max_start = len(stems) - required
             start = (0 if self.is_inference
                      else random.randint(0, max_start) if max_start > 0
                      else 0)
-            frames = stems[start:start + self.sequence_length]
-        raw = self.load_item(root_idx, seq, frames)
-        out = self.process_item(raw)
+            frames = stems[start:start + required:time_step]
+            assert len(frames) == self.sequence_length
+            frame_idx = None
+        try:
+            raw = self.load_item(root_idx, seq, frames)
+            out = self.process_item(raw)
+        finally:
+            self._signal_first_frame(frame_idx)
         out = self.concat_labels(out)  # keeps (T, H, W, C)
         out["key"] = f"{seq}/{frames[-1]}"
         return out
+
+    # -------------------------------------------- first-frame crop barrier
+
+    def _await_first_frame(self, frame_idx):
+        """Pinned-sequence prefetch barrier: frames >0 wait until frame 0
+        has processed (and stashed the sequence common attrs, e.g. the
+        person-crop bbox) so every frame of the window uses ONE crop.
+        Frame 0 is always submitted to the pool first, so this cannot
+        self-deadlock; the timeout guards a wedged first frame (waiters
+        then fall back to computing their own crop, as before)."""
+        ev = getattr(self, "_first_item_event", None)
+        if ev is None or frame_idx is None or frame_idx == 0:
+            return
+        ev.wait(timeout=30.0)
+
+    def _signal_first_frame(self, frame_idx):
+        """Release the barrier once frame 0 finished (even on failure —
+        the exception surfaces in the consumer either way)."""
+        ev = getattr(self, "_first_item_event", None)
+        if ev is not None and frame_idx == 0:
+            ev.set()

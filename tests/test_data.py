@@ -206,3 +206,119 @@ class TestNativeIO:
         loader = DataLoader(DS(), 2, shuffle=False, num_workers=2)
         with pytest.raises(ValueError, match="corrupt sample"):
             list(loader)
+
+
+def _video_cfg(tmp_path, n_frames=40, seq_len=3, max_time_step=3,
+               dataset_type="imaginaire_tpu.data.paired_videos",
+               extra_train=None, extra_data=None):
+    """A folder-backed video config over a synthetic sequence of
+    ``n_frames`` (never actually decoded — tests stub load_item)."""
+    seq_dir = tmp_path / "raw" / "images" / "seq0"
+    seq_dir.mkdir(parents=True, exist_ok=True)
+    for i in range(n_frames):
+        (seq_dir / f"{i:05d}.jpg").touch()
+    c = Config(CFG_PATH)
+    train = {"roots": [str(tmp_path / "raw")], "batch_size": 1,
+             "initial_sequence_length": seq_len,
+             "augmentations": {"resize_h_w": "16, 16",
+                               "max_time_step": max_time_step}}
+    train.update(extra_train or {})
+    c.data = type(c.data)(dict(extra_data or {}, **{
+        "name": "stride_fixture",
+        "type": dataset_type,
+        "num_frames_G": seq_len,
+        "num_workers": 0,
+        "input_types": [
+            {"images": {"ext": "jpg", "num_channels": 3,
+                        "interpolator": "BILINEAR", "normalize": True}}],
+        "input_image": ["images"],
+        "input_labels": [],
+        "train": train,
+        "val": {"roots": [str(tmp_path / "raw")], "batch_size": 1,
+                "augmentations": {"resize_h_w": "16, 16"}},
+    }))
+    return c
+
+
+def _stub_io(ds):
+    """Bypass decode: __getitem__ returns the chosen frame stems."""
+    ds.load_item = lambda root_idx, seq, frames: {"images": list(frames)}
+    ds.process_item = lambda raw, thread_common_attr=True: raw
+    ds.concat_labels = lambda out, squeeze_time=False: out
+    return ds
+
+
+class TestTemporalStride:
+    """max_time_step strided window sampling
+    (ref: datasets/paired_videos.py:167-191)."""
+
+    def test_window_indices_honor_stride(self, tmp_path):
+        import random
+
+        from imaginaire_tpu.registry import resolve
+
+        cfg = _video_cfg(tmp_path, n_frames=40, seq_len=3, max_time_step=3)
+        ds = _stub_io(resolve(cfg.data.type, "Dataset")(cfg))
+        random.seed(7)
+        strides = set()
+        for draw in range(60):
+            frames = ds[draw]["images"]
+            assert len(frames) == 3
+            idx = [int(s) for s in frames]
+            assert 0 <= idx[0] and idx[-1] < 40
+            diffs = {b - a for a, b in zip(idx, idx[1:])}
+            assert len(diffs) == 1, "stride must be constant in a window"
+            step = diffs.pop()
+            assert 1 <= step <= 3
+            strides.add(step)
+        assert strides == {1, 2, 3}, \
+            f"all strides in [1, max_time_step] should occur, got {strides}"
+
+    def test_stride_falls_back_when_window_exceeds_longest(self, tmp_path):
+        import random
+
+        from imaginaire_tpu.registry import resolve
+
+        # seq_len=5: stride s needs 1+4s frames; only s<=2 fits 12
+        cfg = _video_cfg(tmp_path, n_frames=12, seq_len=5, max_time_step=10)
+        ds = _stub_io(resolve(cfg.data.type, "Dataset")(cfg))
+        random.seed(3)
+        for draw in range(40):
+            frames = ds[draw]["images"]
+            assert len(frames) == 5
+            idx = [int(s) for s in frames]
+            step = idx[1] - idx[0]
+            assert step in (1, 2)
+            assert idx[-1] < 12
+
+    def test_few_shot_stride_and_disjoint_refs(self, tmp_path):
+        import random
+
+        from imaginaire_tpu.registry import resolve
+
+        cfg = _video_cfg(
+            tmp_path, n_frames=40, seq_len=3, max_time_step=3,
+            dataset_type="imaginaire_tpu.data.paired_few_shot_videos",
+            extra_data={"initial_few_shot_K": 2})
+        ds = _stub_io(resolve(cfg.data.type, "Dataset")(cfg))
+        random.seed(11)
+        strides = set()
+        for draw in range(60):
+            item = ds[draw]
+            frames = [int(s) for s in item["images"]]
+            refs = [int(s) for s in item["ref_images"]]
+            assert len(frames) == 3 and len(refs) == 2
+            step = frames[1] - frames[0]
+            assert frames[2] - frames[1] == step and 1 <= step <= 3
+            strides.add(step)
+            # refs disjoint from the RAW window [start, end), not just
+            # the strided picks (ref: paired_few_shot_videos.py:182-189)
+            lo, hi = frames[0], frames[0] + (len(frames) - 1) * step + 1
+            assert all(r < lo or r >= hi for r in refs)
+        assert strides == {1, 2, 3}
+
+    def test_knob_never_parses_without_effect(self, cfg):
+        """A non-video dataset handed max_time_step>1 must refuse it."""
+        cfg.data.train.augmentations.max_time_step = 2
+        with pytest.raises(ValueError, match="max_time_step"):
+            PairedImages(cfg)

@@ -281,3 +281,68 @@ class TestResolutionSelection:
         assert set(np.unique(near)) <= {0.0, 7.0}  # no blended values
         (lin,) = crop_and_resize([f], [0, 32, 0, 32], (48, 48))
         assert len(np.unique(lin)) > 2  # bilinear blends the boundary
+
+
+class TestFirstWindowBarrier:
+    def test_prefetch_workers_share_frame0_bbox(self, tmp_path):
+        """With num_workers>1 every frame of a pinned sequence must use
+        frame 0's crop bbox: workers block on the first-frame barrier
+        until frame 0 stashes it (data/paired_videos.py::
+        _await_first_frame). The densepose support shifts per frame, so
+        an independently computed bbox would differ."""
+        import imaginaire_tpu.model_utils.fs_vid2vid as fsu
+        from imaginaire_tpu.data.loader import DataLoader
+
+        root = str(tmp_path / "raw")
+        t = 8
+        for dtype in ("images", "pose_maps-densepose"):
+            os.makedirs(os.path.join(root, dtype, "seq0"), exist_ok=True)
+        rng = np.random.RandomState(0)
+        for i in range(t):
+            img = rng.randint(0, 255, (96, 128, 3), np.uint8)
+            cv2.imwrite(os.path.join(root, "images", "seq0",
+                                     f"{i:05d}.jpg"), img)
+            dp = np.zeros((96, 128, 3), np.uint8)
+            dp[20 + 3 * i:60 + 3 * i, 30 + 4 * i:70 + 4 * i] = 120
+            cv2.imwrite(os.path.join(root, "pose_maps-densepose", "seq0",
+                                     f"{i:05d}.png"), dp)
+        cfg = _pose_cfg(root)
+        # trim to the two modalities this fixture writes
+        cfg.data.input_types = [it for it in cfg.data.input_types
+                                if list(it)[0] in ("images",
+                                                   "pose_maps-densepose")]
+        cfg.data.input_labels = ["pose_maps-densepose"]
+        cfg.data.keypoint_data_types = []
+
+        used_coords = []
+        orig = fsu.crop_person_from_data
+        record_lock = __import__("threading").Lock()
+
+        def recording(cfg_, is_inference, data, rng=None):
+            # frame 0 (densepose support starting at row 20) is made slow
+            # so without the barrier later frames would outrun its stash
+            dp0 = np.asarray(data["pose_maps-densepose"][0])
+            if int(np.nonzero(dp0.sum((1, 2)))[0][0]) == 20:
+                __import__("time").sleep(0.5)
+            out = orig(cfg_, is_inference, data, rng=rng)
+            with record_lock:
+                used_coords.append(tuple(out["common_attr"]["crop_coords"]))
+            return out
+
+        fsu.crop_person_from_data = recording
+        try:
+            ds = resolve(cfg.data.type, "Dataset")(cfg, is_inference=True)
+            ds.set_inference_sequence_idx(0)
+            # batch_size>1 makes the pool process a window's frames
+            # concurrently — the racy case (batch-1 pinned loaders are
+            # sequential by construction)
+            loader = DataLoader(ds, batch_size=4, shuffle=False,
+                                drop_last=False, num_workers=4,
+                                prefetch_batches=2,
+                                shard_by_process=False)
+            n = sum(1 for _ in loader)
+        finally:
+            fsu.crop_person_from_data = orig
+        assert n == 2 and len(used_coords) == t
+        assert len(set(used_coords)) == 1, \
+            f"every frame must reuse frame 0's bbox, got {set(used_coords)}"
