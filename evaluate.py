@@ -16,6 +16,7 @@ import jax
 from imaginaire_tpu.config import Config
 from imaginaire_tpu.data import get_train_and_val_dataloader
 from imaginaire_tpu.parallel.mesh import (
+    honor_platform_env,
     create_mesh,
     master_only_print as print,  # noqa: A001
     set_mesh,
@@ -42,6 +43,7 @@ def parse_args():
 
 
 def main():
+    honor_platform_env()
     args = parse_args()
     cfg = Config(args.config)
     set_mesh(create_mesh(tuple(cfg.runtime.mesh.axes),

@@ -72,10 +72,18 @@ class NonLocal2dBlock(nn.Module):
                 # shard the batch over 'data' too when it divides —
                 # P(None, seq) would all-gather the batch into every
                 # data-parallel row and redo identical attention there
+                axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+                ring_size = axis_sizes[self.ring_axis]
+                if (h * w) % ring_size != 0:
+                    raise ValueError(
+                        f"non_local ring attention shards the {h}x{w} "
+                        f"feature map's {h * w} tokens over mesh axis "
+                        f"{self.ring_axis!r} of size {ring_size}, which "
+                        "does not divide evenly; pick a feature-map size "
+                        f"divisible by {ring_size} or shrink the axis")
                 batch_axis = None
                 if "data" in mesh.axis_names and self.ring_axis != "data":
-                    if b % dict(zip(mesh.axis_names,
-                                    mesh.devices.shape))["data"] == 0:
+                    if b % axis_sizes["data"] == 0:
                         batch_axis = "data"
                 spec = P(batch_axis, self.ring_axis)
                 y = shard_map(

@@ -41,6 +41,21 @@ def init_distributed(coordinator_address=None, num_processes=None, process_id=No
         )
 
 
+def honor_platform_env():
+    """Re-assert ``JAX_PLATFORMS`` from the environment as jax config.
+
+    The axon boot shim (sitecustomize.py) registers the tunneled TPU
+    backend at interpreter start, which defeats a ``JAX_PLATFORMS=cpu``
+    set on the command line — subprocesses that asked for the virtual
+    CPU mesh silently get the single real chip instead. CLI entry points
+    call this before any jax op; the config knob wins over the shim."""
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+
 def create_mesh(axes=("data",), shape=None, devices=None):
     """Create a Mesh over the given logical axes.
 

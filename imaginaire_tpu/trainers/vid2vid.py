@@ -452,25 +452,30 @@ class Trainer(BaseTrainer):
         d_hist, g_hist = [], []
         for t in range(seq_len):
             data_t = self._get_data_t(data, t, prev_labels, prev_images)
-            data_t["past_stacks"] = self._past_stacks(past_real, past_fake)
-            # keys starting with '_' carry host-side objects (e.g.
-            # wc-vid2vid point clouds) and must not cross the jit boundary
-            data_jit = {k: v for k, v in data_t.items()
-                        if not k.startswith("_")}
-            self.state, d_losses = self._jit_vid_dis(self.state, data_jit)
-            self.state, g_losses, fake = self._jit_vid_gen(self.state,
-                                                           data_jit)
+            fake = self._frame_override(data_t)
+            if fake is None:
+                data_t["past_stacks"] = self._past_stacks(past_real,
+                                                          past_fake)
+                # keys starting with '_' carry host-side objects (e.g.
+                # wc-vid2vid point clouds) and must not cross the jit
+                # boundary
+                data_jit = {k: v for k, v in data_t.items()
+                            if not k.startswith("_")}
+                self.state, d_losses = self._jit_vid_dis(self.state,
+                                                         data_jit)
+                self.state, g_losses, fake = self._jit_vid_gen(self.state,
+                                                               data_jit)
+                d_hist.append(d_losses)
+                g_hist.append(g_losses)
+                if self.num_temporal_scales > 0:
+                    past_real = concat_frames(past_real, data_t["image"],
+                                              max_prev)
+                    past_fake = concat_frames(past_fake, fake, max_prev)
             self._after_gen_frame(data_t, fake)
-            d_hist.append(d_losses)
-            g_hist.append(g_losses)
             prev_labels = concat_frames(prev_labels, data_t["label"],
                                         self.num_frames_G - 1)
             prev_images = concat_frames(prev_images, fake,
                                         self.num_frames_G - 1)
-            if self.num_temporal_scales > 0:
-                past_real = concat_frames(past_real, data_t["image"],
-                                          max_prev)
-                past_fake = concat_frames(past_fake, fake, max_prev)
         if self.speed_benchmark:
             jax.block_until_ready(self.state["vars_G"]["params"])
             self._meter("time/gen_step").write(time.time() - t0)
@@ -489,6 +494,15 @@ class Trainer(BaseTrainer):
         """Hook after each frame's G step (wc-vid2vid colors its point
         cloud here). Default: no-op."""
         pass
+
+    def _frame_override(self, data_t):
+        """Hook: return a replacement fake frame for ``data_t``, or None
+        to run the normal D/G steps. Override frames skip both updates
+        and the temporal-D past stacks but still feed the prev-frame
+        history (ref: trainers/vid2vid.py:264-284, the
+        ``fake_images_source == 'pretrained'`` gating; wc-vid2vid's
+        frozen single-image takeover lives here). Default: None."""
+        return None
 
     def _start_of_test_sequence(self, data):
         """Hook before generating a test sequence (wc-vid2vid resets its
@@ -519,13 +533,15 @@ class Trainer(BaseTrainer):
         data_t = self._get_data_t(data, t,
                                   getattr(self, "_test_prev_labels", None),
                                   getattr(self, "_test_prev_images", None))
-        out, _ = self._apply_G(
-            self.inference_params(),
-            {k: v for k, v in data_t.items() if not k.startswith("_")},
-            jax.random.PRNGKey(getattr(self, "_test_seq", 0) * 100003
-                               + getattr(self, "_test_t", 0)),
-            training=False)
-        fake = out["fake_images"]
+        fake = self._frame_override(data_t)
+        if fake is None:
+            out, _ = self._apply_G(
+                self.inference_params(),
+                {k: v for k, v in data_t.items() if not k.startswith("_")},
+                jax.random.PRNGKey(getattr(self, "_test_seq", 0) * 100003
+                                   + getattr(self, "_test_t", 0)),
+                training=False)
+            fake = out["fake_images"]
         self._after_gen_frame(data_t, fake)
         self._test_prev_labels = concat_frames(
             getattr(self, "_test_prev_labels", None), data_t["label"],
@@ -752,9 +768,12 @@ class Trainer(BaseTrainer):
         fakes = []
         for t in range(seq_len):
             data_t = self._get_data_t(data, t, prev_labels, prev_images)
-            out, _ = self._apply_G(variables, data_t, jax.random.PRNGKey(0),
-                                   training=False)
-            fake = out["fake_images"]
+            fake = self._frame_override(data_t)
+            if fake is None:
+                out, _ = self._apply_G(variables, data_t,
+                                       jax.random.PRNGKey(0),
+                                       training=False)
+                fake = out["fake_images"]
             fakes.append(fake)
             prev_labels = concat_frames(prev_labels, data_t["label"],
                                         self.num_frames_G - 1)

@@ -11,6 +11,8 @@ between steps.
 
 from __future__ import annotations
 
+import re
+
 import numpy as np
 
 from imaginaire_tpu.config import cfg_get
@@ -26,6 +28,139 @@ class Trainer(Vid2VidTrainer):
         super().__init__(cfg, *args, **kwargs)
         self.renderers = {}  # per batch element
         self.is_flipped_input = False
+        self.single_image_model = None
+        self.single_image_vars = None
+        self._single_z_key = None
+        self._init_single_image_model(cfg)
+
+    # --------------------------------------------------- single-image model
+
+    def _init_single_image_model(self, cfg):
+        """Frozen, separately-trained SPADE generator that synthesizes
+        frames until the flow estimate warms up
+        (ref: generators/wc_vid2vid.py:45-70 init,
+        trainers/wc_vid2vid.py:504-510 weight loading).
+
+        ``gen.single_image_model.config`` names the single-image stage's
+        config (the ``*_single.yaml``); ``.checkpoint`` names its trained
+        checkpoint (dir or a logdir with latest_checkpoint.txt). A
+        missing checkpoint fails loudly; ``allow_random_init: True``
+        permits random weights for tests."""
+        import os
+
+        from imaginaire_tpu.config import Config, as_attrdict
+        from imaginaire_tpu.registry import resolve
+
+        sim_cfg = cfg_get(cfg.gen, "single_image_model", None)
+        if sim_cfg is None:
+            return
+        sim_cfg = as_attrdict(sim_cfg)
+        cfg_path = cfg_get(sim_cfg, "config", None)
+        if cfg_path is None:
+            raise ValueError(
+                "gen.single_image_model needs a 'config' key naming the "
+                "single-image stage's yaml")
+        cfg_path = self._resolve_config_path(
+            cfg_path, cfg_get(cfg, "source_filename", None))
+        single_cfg = Config(cfg_path)
+        self.single_image_model = resolve(
+            single_cfg.gen.type, "Generator")(single_cfg.gen,
+                                              single_cfg.data)
+        ckpt = cfg_get(sim_cfg, "checkpoint", None)
+        if ckpt:
+            from imaginaire_tpu.utils.checkpoint import (
+                latest_checkpoint_path,
+                load_checkpoint,
+            )
+
+            path = ckpt
+            if os.path.isdir(ckpt) and os.path.exists(
+                    os.path.join(ckpt, "latest_checkpoint.txt")):
+                path = latest_checkpoint_path(ckpt)
+            if path is None or not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"gen.single_image_model.checkpoint={ckpt!r} does not "
+                    "resolve to a checkpoint; train the single-image stage "
+                    f"({cfg_path}) first")
+            state = load_checkpoint(path)
+            if "vars_G" not in state:
+                raise ValueError(
+                    f"checkpoint {path} has no generator variables "
+                    "('vars_G'); is it a training checkpoint?")
+            self.single_image_vars = state["vars_G"]
+            print(f"Loaded single image model from {path}")
+        elif not cfg_get(sim_cfg, "allow_random_init", False):
+            raise ValueError(
+                "gen.single_image_model needs a 'checkpoint' key (or "
+                "allow_random_init: True for tests) — without trained "
+                "weights the early-sequence takeover would emit noise")
+        else:
+            print("single_image_model: RANDOM weights "
+                  "(allow_random_init) — test use only")
+        import jax as _jax
+
+        self._jit_single = _jax.jit(
+            lambda v, d, k: self.single_image_model.apply(
+                v, d, random_style=True, training=False,
+                rngs={"noise": k}))
+
+    @staticmethod
+    def _resolve_config_path(path, parent_config_path):
+        """Resolve the single-image config path like the repo-root-
+        relative paths the configs ship ('configs/projects/...'): try
+        the CWD first, then walk up from the PARENT config's directory —
+        so training works from any working directory, not just the repo
+        root."""
+        import os
+
+        if os.path.isabs(path) or os.path.exists(path):
+            return path
+        base = os.path.dirname(os.path.abspath(parent_config_path)) \
+            if parent_config_path else None
+        while base:
+            candidate = os.path.join(base, path)
+            if os.path.exists(candidate):
+                return candidate
+            parent = os.path.dirname(base)
+            if parent == base:
+                break
+            base = parent
+        return path  # let Config() raise its own FileNotFoundError
+
+    def _frame_override(self, data_t):
+        """Frozen single-image SPADE takeover while flow features are
+        unavailable (ref: generators/wc_vid2vid.py:169-185): the same
+        not-``warp_prev`` frames the wc generator would synthesize from
+        scratch come from the pretrained model instead, with a
+        per-sequence cached style z (here: a cached rng key — same key,
+        same z). Those frames skip the D/G updates (the base rollout's
+        override contract) and still color the point cloud + feed the
+        prev-frame history."""
+        import jax
+
+        if self.single_image_model is None:
+            return None
+        prev = data_t.get("prev_images")
+        warp_prev = (self.use_flow and prev is not None
+                     and prev.shape[1] == self.num_frames_G - 1)
+        if warp_prev:
+            return None
+        if self.single_image_vars is None:  # allow_random_init path
+            self.single_image_vars = jax.jit(
+                lambda k, d: self.single_image_model.init(
+                    {"params": k, "noise": k}, d, random_style=True,
+                    training=False))(
+                jax.random.PRNGKey(0),
+                {"label": data_t["label"], "images": data_t["image"]})
+        if self._single_z_key is None:
+            self._single_seq = getattr(self, "_single_seq", -1) + 1
+            self._single_z_key = jax.random.PRNGKey(
+                77321 + self._single_seq)
+        out = self._jit_single(
+            self.single_image_vars,
+            {"label": data_t["label"], "images": data_t["image"]},
+            self._single_z_key)
+        return out["fake_images"].astype(data_t["image"].dtype)
 
     def _init_loss(self, cfg):
         """vid2vid losses plus the guidance term: masked L1 between the
@@ -56,9 +191,12 @@ class Trainer(Vid2VidTrainer):
         return losses, new_mut, out
 
     def reset_renderer(self, is_flipped_input=False):
-        """(ref: generators/wc_vid2vid.py:72-80)."""
+        """(ref: generators/wc_vid2vid.py:72-80; the per-sequence style z
+        of the single-image model resets with the point cloud,
+        ref: wc_vid2vid.py:79 ``single_image_model_z = None``)."""
         self.renderers = {}
         self.is_flipped_input = is_flipped_input
+        self._single_z_key = None
 
     def _renderer(self, b):
         if b not in self.renderers:
@@ -66,24 +204,39 @@ class Trainer(Vid2VidTrainer):
         return self.renderers[b]
 
     @staticmethod
+    def _resolution_hw(key):
+        """(H, W) parsed from a resolution key, or None.
+
+        Two formats exist in the wild: the reference pickles
+        unprojections under 'w{W}xh{H}' keys (ref:
+        generators/wc_vid2vid.py:103 hardcodes 'w1024xh512') while this
+        repo's decode path emits '{H}x{W}'."""
+        m = re.fullmatch(r"w(\d+)xh(\d+)", str(key).lower())
+        if m:
+            return int(m.group(2)), int(m.group(1))
+        m = re.fullmatch(r"(\d+)x(\d+)", str(key).lower())
+        if m:
+            return int(m.group(1)), int(m.group(2))
+        return None
+
+    @staticmethod
     def _finest_resolution(mapping, target_hw=None):
-        """Pick the '<H>x<W>' entry matching ``target_hw`` when present
-        (its pixel coordinates index the guidance canvas of exactly that
-        size), else the finest (string max would sort '64x64' above
-        '256x256'); None when the window recorded no mappings at all."""
+        """Pick the entry whose resolution key matches ``target_hw``
+        when present (its pixel coordinates index the guidance canvas of
+        exactly that size), else the finest (string max would sort
+        '64x64' above '256x256'); None when the window recorded no
+        mappings at all. Accepts both '{H}x{W}' and the reference's
+        'w{W}xh{H}' key formats."""
         if not mapping:
             return None
         if target_hw is not None:
-            key = f"{target_hw[0]}x{target_hw[1]}"
-            if key in mapping:
-                return mapping[key]
+            for key in mapping:
+                if Trainer._resolution_hw(key) == tuple(target_hw):
+                    return mapping[key]
 
         def pixel_count(key):
-            try:
-                h, w = str(key).lower().split("x")
-                return int(h) * int(w)
-            except ValueError:
-                return -1
+            hw = Trainer._resolution_hw(key)
+            return hw[0] * hw[1] if hw else -1
 
         return mapping[max(mapping.keys(), key=pixel_count)]
 
@@ -117,7 +270,14 @@ class Trainer(Vid2VidTrainer):
             elif b == 0:
                 entry = unproj  # single-sample {res: (T, N, 3)}
             else:
-                return None  # no mapping recorded for this sample
+                # a per-sample dict reaching a b>0 lookup means an
+                # uncollated sample met batch_size>1 — guidance would
+                # silently vanish for every sample past the first
+                raise ValueError(
+                    "wc_vid2vid: got a single-sample unprojection dict "
+                    f"but was asked for batch element {b}; collate "
+                    "per-sample dicts into a list (or stack) before "
+                    "handing them to the trainer")
         else:
             entry = unproj[b]
             if isinstance(entry, dict):  # collated list of sample dicts

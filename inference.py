@@ -13,6 +13,7 @@ import jax
 from imaginaire_tpu.config import Config, cfg_get
 from imaginaire_tpu.data import get_test_dataloader
 from imaginaire_tpu.parallel.mesh import (
+    honor_platform_env,
     create_mesh,
     master_only_print as print,  # noqa: A001
     set_mesh,
@@ -34,6 +35,7 @@ def parse_args():
 
 
 def main():
+    honor_platform_env()
     args = parse_args()
     cfg = Config(args.config)
     set_mesh(create_mesh(tuple(cfg.runtime.mesh.axes),

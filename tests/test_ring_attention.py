@@ -143,6 +143,25 @@ class TestRingAttention:
         finally:
             set_mesh(old)
 
+    def test_non_local_ring_token_count_not_divisible_raises(self, rng):
+        """A feature-map whose token count doesn't divide the ring axis
+        must fail with an actionable message, not an opaque GSPMD
+        error."""
+        from imaginaire_tpu.layers.non_local import NonLocal2dBlock
+        from imaginaire_tpu.parallel.mesh import create_mesh, get_mesh, set_mesh
+
+        old = get_mesh()
+        try:
+            set_mesh(create_mesh(("data", "seq"), (2, 4)))
+            # 5x5 = 25 tokens, not divisible by the seq axis size 4
+            x = jnp.asarray(rng.randn(1, 5, 5, 16).astype(np.float32))
+            variables = NonLocal2dBlock().init(jax.random.PRNGKey(0), x)
+            blk = NonLocal2dBlock(ring_axis="seq")
+            with pytest.raises(ValueError, match="divide"):
+                blk.apply(variables, x)
+        finally:
+            set_mesh(old)
+
 
 @pytest.mark.slow
 class TestGeneratorRingAttention:

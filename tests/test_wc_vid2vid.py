@@ -143,8 +143,10 @@ class TestDecodeUnprojections:
         info = trainer._point_info(data, 1, 0)
         assert info.shape == (1, 3) and info[0].tolist() == [3, 3, 9]
         assert trainer._point_info(data, 2, 0).shape == (0, 3)
-        # single-sample dict has no data for b>0
-        assert trainer._point_info(data, 0, 1) is None
+        # a single-sample dict reaching a b>0 lookup is a collation bug
+        # that would silently drop guidance — it must fail loudly
+        with pytest.raises(ValueError, match="single-sample"):
+            trainer._point_info(data, 0, 1)
 
         # the DataLoader collates per-sample dicts into a list of dicts
         collated = {"unprojections": [out, out]}
@@ -155,6 +157,117 @@ class TestDecodeUnprojections:
                    {k: np.stack([v, v]) for k, v in out.items()}}
         info = trainer._point_info(stacked, 0, 1)
         assert info.shape == (2, 3) and info[0].tolist() == [0, 0, 5]
+
+    def test_reference_resolution_key_format(self, tmp_path):
+        """The reference pickles unprojections under 'w{W}xh{H}' keys
+        (ref: generators/wc_vid2vid.py:103 'w1024xh512'); both that and
+        the repo's '{H}x{W}' format must match the canvas and rank by
+        true pixel count."""
+        from imaginaire_tpu.trainers.wc_vid2vid import Trainer
+
+        assert Trainer._resolution_hw("w1024xh512") == (512, 1024)
+        assert Trainer._resolution_hw("512x1024") == (512, 1024)
+        assert Trainer._resolution_hw("not-a-res") is None
+
+        fine = np.arange(6).reshape(2, 3)
+        coarse = np.zeros((1, 3))
+        # reference-format keys: target canvas (512, 1024) must pick
+        # 'w1024xh512', not fall back to dict order
+        mapping = {"w256xh128": coarse, "w1024xh512": fine}
+        assert Trainer._finest_resolution(
+            mapping, target_hw=(512, 1024)) is fine
+        # no target: rank by pixel count across both formats
+        mixed = {"64x64": coarse, "w1024xh512": fine}
+        assert Trainer._finest_resolution(mixed) is fine
+
+
+class TestSingleImageModel:
+    """Frozen single-image SPADE takeover
+    (ref: generators/wc_vid2vid.py:45-70,169-185)."""
+
+    def _cfg(self, tmp_path, **sim):
+        cfg = Config(CFG)
+        cfg.logdir = str(tmp_path)
+        cfg.gen.single_image_model = type(cfg.gen)(dict(
+            {"config": os.path.join(os.path.dirname(CFG), "spade.yaml")},
+            **sim))
+        return cfg
+
+    def test_missing_checkpoint_fails_loudly(self, tmp_path):
+        cfg = self._cfg(tmp_path, checkpoint=str(tmp_path / "missing_ckpt"))
+        with pytest.raises(FileNotFoundError, match="single_image_model"):
+            resolve(cfg.trainer.type, "Trainer")(cfg)
+
+    def test_checkpoint_key_required(self, tmp_path):
+        cfg = self._cfg(tmp_path)
+        with pytest.raises(ValueError, match="checkpoint"):
+            resolve(cfg.trainer.type, "Trainer")(cfg)
+
+    @pytest.mark.slow
+    def test_takeover_flows_into_early_frames(self, rng, tmp_path):
+        """Until the prev-frame history fills (warp_prev False), frames
+        come from the frozen single-image model — they skip the D/G
+        updates but still color the point cloud and feed the history
+        (ref: trainers/vid2vid.py:264-284 'pretrained' gating)."""
+        cfg = self._cfg(tmp_path, allow_random_init=True)
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        assert trainer.single_image_model is not None
+        const = 0.625
+        trainer.single_image_vars = {}  # stubbed below; skip lazy init
+        trainer._jit_single = lambda v, d, k: {
+            "fake_images": jnp.full_like(d["images"], const)}
+        seen = []
+        orig_after = trainer._after_gen_frame
+
+        def record(data_t, fake):
+            seen.append(np.asarray(jax.device_get(fake)))
+            orig_after(data_t, fake)
+
+        trainer._after_gen_frame = record
+        trainer.init_state(jax.random.PRNGKey(0), wc_video_batch(rng))
+        batch = trainer.start_of_iteration(wc_video_batch(rng), 1)
+        g = trainer.gen_update(batch)
+        # num_frames_G=3: frames 0 and 1 lack the 2-frame history ->
+        # stub output; frame 2 is the first in-training frame
+        assert len(seen) == 3
+        assert np.allclose(seen[0], const) and np.allclose(seen[1], const)
+        assert not np.allclose(seen[2], const)
+        for name, v in g.items():
+            assert np.isfinite(float(jax.device_get(v))), name
+        # the stub frames colored the point cloud with the stub value
+        # (first-seen color persists; frame 2's real-G output colors only
+        # the points first seen in frame 2)
+        r = trainer._renderer(0)
+        assert r.num_points() > 0
+        expected = int((const * 0.5 + 0.5) * 255)
+        colored = r.colors[(r.colors != 0).any(-1)]
+        values, counts = np.unique(colored, return_counts=True)
+        assert expected in values
+        # the two stub frames seeded most of the cloud
+        assert counts[values == expected][0] >= counts.sum() / 3
+
+    @pytest.mark.slow
+    def test_real_spade_takeover_apply_at_256(self, rng, tmp_path):
+        """The REAL frozen SPADE apply (no stub): a 256px wc config whose
+        early frame is synthesized by the single-image model, and the
+        per-sequence z is cached (same z -> identical frames)."""
+        cfg = self._cfg(tmp_path, allow_random_init=True)
+        for split in ("train", "val"):
+            cfg.data[split].augmentations.resize_h_w = "256, 256"
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        data = {"images": np.asarray(
+                    rng.rand(1, 256, 256, 3).astype(np.float32)) * 2 - 1,
+                "label": np.asarray(
+                    (rng.rand(1, 256, 256, 12) > 0.9).astype(np.float32))}
+        trainer.reset()
+        out1 = np.asarray(trainer.test_single(dict(data))["fake_images"])
+        assert out1.shape == (1, 256, 256, 3)
+        assert np.all(np.isfinite(out1)) and np.abs(out1).max() > 0
+        # same sequence -> cached z -> a repeated frame is identical
+        key1 = trainer._single_z_key
+        out2 = np.asarray(trainer.test_single(dict(data))["fake_images"])
+        assert trainer._single_z_key is key1
+        np.testing.assert_array_equal(out1, out2)
 
 
 @pytest.mark.slow

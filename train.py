@@ -13,7 +13,7 @@ import jax
 
 from imaginaire_tpu.config import Config, cfg_get
 from imaginaire_tpu.data import get_train_and_val_dataloader
-from imaginaire_tpu.parallel.mesh import create_mesh, master_only_print as print, set_mesh
+from imaginaire_tpu.parallel.mesh import create_mesh, master_only_print as print, set_mesh, honor_platform_env
 from imaginaire_tpu.registry import resolve
 from imaginaire_tpu.utils.logging_utils import init_logging, make_logging_dir
 
@@ -30,6 +30,7 @@ def parse_args():
 
 
 def main():
+    honor_platform_env()
     args = parse_args()
     cfg = Config(args.config)
     if args.max_iter is not None:
