@@ -104,6 +104,110 @@ def build_unit():
     return trainer, n_seg + 1
 
 
+def build_vid2vid(flow_teacher=True):
+    """The shipped cityscapes vid2vid recipe (512x1024, bs2, interleaved
+    per-frame D+G rollout with flow warp + multi-SPADE combine)."""
+    from imaginaire_tpu.config import Config
+    from imaginaire_tpu.registry import resolve
+    from imaginaire_tpu.utils.data import get_paired_input_label_channel_number
+
+    cfg = Config(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "configs", "projects", "vid2vid", "cityscapes",
+                              "bf16.yaml"))
+    # no pretrained VGG / FlowNet2 weights in this environment; random
+    # weights cost the same (the FlowNet2 teacher stays in the graph)
+    cfg.trainer.perceptual_loss.allow_random_init = True
+    cfg.trainer.perceptual_loss.pop("weights_path", None)
+    if flow_teacher:
+        cfg.flow_network.allow_random_init = True
+        cfg.flow_network.pop("weights_path", None)
+    else:
+        # fallback leg: the fork's warp-consistency flow loss instead of
+        # the FlowNet2 teacher (the teacher's 512x1024 cascade is what
+        # the tunneled compile helper rejects)
+        cfg.pop("flow_network", None)
+    trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+    return trainer, get_paired_input_label_channel_number(cfg.data)
+
+
+def vid2vid_batch(bs, t, label_ch, h=512, w=1024):
+    rng = np.random.RandomState(0)
+    lab = np.zeros((bs, t, h, w, label_ch), np.float32)
+    idx = rng.randint(0, label_ch, (bs, t, h, w))
+    np.put_along_axis(lab, idx[..., None], 1.0, axis=-1)
+    return {
+        "images": rng.rand(bs, t, h, w, 3).astype(np.float32) * 2 - 1,
+        "label": lab,
+    }
+
+
+def run_vid2vid(seq_len=4):
+    """Steady-state frames/sec of the interleaved per-frame rollout.
+
+    The reference publishes no vid2vid throughput numbers, so
+    vs_baseline is null; the number is tracked round-over-round
+    (BASELINE.json tracked-config list; ref timer semantics
+    trainers/base.py:723-787). Legs sweep (bs, flow-teacher); the
+    ``_noteacher`` metric marks the warp-consistency fallback used when
+    the FlowNet2 teacher cascade won't compile through the tunnel."""
+    import jax
+    import jax.numpy as jnp
+
+    last_error = None
+    trainer = data = None
+    for bs, flow_teacher in ((2, True), (1, True), (2, False), (1, False)):
+        try:
+            # drop the previous leg's device state BEFORE building the
+            # next trainer — otherwise old + new HBM must coexist and a
+            # smaller batch can OOM spuriously
+            if trainer is not None:
+                trainer.state = None
+            trainer = data = None
+            jax.clear_caches()
+            trainer, label_ch = build_vid2vid(flow_teacher)
+            data = jax.device_put(jax.tree_util.tree_map(
+                np.asarray, vid2vid_batch(bs, seq_len, label_ch)))
+            jax.block_until_ready(data)
+            trainer.init_state(jax.random.PRNGKey(0), data)
+
+            def sync():
+                leaf = jax.tree_util.tree_leaves(
+                    trainer.state["vars_G"]["params"])[0]
+                return float(jnp.sum(leaf))
+
+            for _ in range(2):  # compile both per-frame programs + warm
+                trainer.dis_update(data)
+                g_losses = trainer.gen_update(data)
+            sync()
+            bad = [k for k, v in g_losses.items()
+                   if not np.isfinite(float(jnp.asarray(v)))]
+            if bad:
+                raise SystemExit(f"non-finite losses at bs={bs}: {bad}")
+            iters = 4
+            t0 = time.time()
+            for _ in range(iters):
+                trainer.dis_update(data)
+                trainer.gen_update(data)
+            sync()
+            dt = time.time() - t0
+            frames_per_sec = bs * seq_len * iters / dt
+            metric = "vid2vid_512x1024_train_frames_per_sec_per_chip"
+            if not flow_teacher:
+                metric += "_noteacher"
+            print(json.dumps({
+                "metric": metric,
+                "value": round(frames_per_sec, 3),
+                "unit": "frames/sec/chip",
+                "vs_baseline": None,
+            }))
+            return
+        except Exception as e:  # OOM etc. -> halve batch
+            last_error = e
+            continue
+    raise SystemExit(f"vid2vid bench failed at all batch sizes: "
+                     f"{last_error}")
+
+
 def batch_of(bs, label_ch):
     # int label map, one-hot expanded on device inside the jitted step —
     # ships ~KB/img to the chip instead of ~48MB of one-hot floats.
@@ -175,7 +279,15 @@ def main():
     parser.add_argument("--width", choices=("zoo", "unit"), default="zoo",
                         help="zoo = faithful nf=128 base128_bs4.yaml budget "
                              "(headline); unit = nf=64 unit-test width")
+    parser.add_argument("--model", choices=("spade", "vid2vid"),
+                        default="spade",
+                        help="spade = headline image bench (default); "
+                             "vid2vid = cityscapes 512x1024 interleaved "
+                             "rollout (VIDBENCH.json)")
     args = parser.parse_args()
+    if args.model == "vid2vid":
+        run_vid2vid()
+        return
     if args.width == "zoo":
         trainer, label_ch = build_zoo()
         # nf=128 is ~4x the unit-width FLOPs; sweep down on OOM

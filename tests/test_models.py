@@ -1,6 +1,7 @@
 """Generator/discriminator model tests (ref architectures in
 imaginaire/generators/spade.py, imaginaire/discriminators/{multires_patch,
 fpse,spade,residual,mlp_multiclass}.py)."""
+import os
 
 import jax
 import jax.numpy as jnp
@@ -156,3 +157,39 @@ def test_mlp_multiclass(key, rng):
     out, _ = d.init_with_output({"params": key, "dropout": key}, data,
                                 training=True)
     assert out["results"].shape == (3, 7)
+
+
+class TestSpadeRemat:
+    """gen.remat knob (TPU memory/speed lever; measured in PROFILE.md)."""
+
+    def test_param_tree_identical_and_bad_value_loud(self, rng, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from imaginaire_tpu.config import Config
+        from imaginaire_tpu.registry import resolve
+
+        cfg_path = os.path.join(os.path.dirname(__file__), "..", "configs",
+                                "unit_test", "spade.yaml")
+        data = {"images": jnp.asarray(
+                    rng.rand(1, 256, 256, 3).astype(np.float32)),
+                "label": jnp.asarray(
+                    (rng.rand(1, 256, 256, 14) > 0.9).astype(np.float32))}
+        trees = []
+        for remat in ("none", "blocks"):
+            cfg = Config(cfg_path)
+            cfg.logdir = str(tmp_path)
+            cfg.gen.remat = remat
+            gen = resolve(cfg.gen.type, "Generator")(cfg.gen, cfg.data)
+            variables = gen.init({"params": jax.random.PRNGKey(0),
+                                  "noise": jax.random.PRNGKey(1)}, data)
+            trees.append(jax.tree_util.tree_structure(variables["params"]))
+        # the knob must be checkpoint-compatible: same parameter tree
+        assert trees[0] == trees[1]
+
+        cfg = Config(cfg_path)
+        cfg.gen.remat = "block"  # typo'd value must fail loudly
+        gen = resolve(cfg.gen.type, "Generator")(cfg.gen, cfg.data)
+        with pytest.raises(ValueError, match="remat"):
+            gen.init({"params": jax.random.PRNGKey(0),
+                      "noise": jax.random.PRNGKey(1)}, data)
