@@ -194,12 +194,20 @@ def run_vid2vid(seq_len=4):
             metric = "vid2vid_512x1024_train_frames_per_sec_per_chip"
             if not flow_teacher:
                 metric += "_noteacher"
-            print(json.dumps({
+            payload = {
                 "metric": metric,
                 "value": round(frames_per_sec, 3),
                 "unit": "frames/sec/chip",
                 "vs_baseline": None,
-            }))
+            }
+            with open(os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "VIDBENCH.json"), "w") as f:
+                json.dump(dict(payload, batch_size=bs, seq_len=seq_len,
+                               flow_teacher=flow_teacher,
+                               per_frame_step_ms=round(
+                                   dt * 1e3 / (seq_len * iters), 2)), f,
+                          indent=1)
+            print(json.dumps(payload))
             return
         except Exception as e:  # OOM etc. -> halve batch
             last_error = e
