@@ -104,9 +104,11 @@ def build_unit():
     return trainer, n_seg + 1
 
 
-def build_vid2vid(flow_teacher=True):
+def build_vid2vid(flow_teacher=True, hw=(512, 1024)):
     """The shipped cityscapes vid2vid recipe (512x1024, bs2, interleaved
-    per-frame D+G rollout with flow warp + multi-SPADE combine)."""
+    per-frame D+G rollout with flow warp + multi-SPADE combine).
+    ``hw`` below (512, 1024) is the measured-fallback size for the
+    tunneled compiler (metric name flags it)."""
     from imaginaire_tpu.config import Config
     from imaginaire_tpu.registry import resolve
     from imaginaire_tpu.utils.data import get_paired_input_label_channel_number
@@ -126,6 +128,17 @@ def build_vid2vid(flow_teacher=True):
         # the FlowNet2 teacher (the teacher's 512x1024 cascade is what
         # the tunneled compile helper rejects)
         cfg.pop("flow_network", None)
+    if hw != (512, 1024):
+        # the generator statically sizes from the config augmentations
+        hw_str = f"{hw[0]}, {hw[1]}"
+        for split in ("train", "val"):
+            aug = cfg.data[split].augmentations
+            aug.pop("resize_smallest_side", None)
+            for key in ("random_crop_h_w", "center_crop_h_w",
+                        "resize_h_w"):
+                if key in aug:
+                    aug.pop(key)
+            aug.resize_h_w = hw_str
     trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
     return trainer, get_paired_input_label_channel_number(cfg.data)
 
@@ -155,7 +168,14 @@ def run_vid2vid(seq_len=4):
 
     last_error = None
     trainer = data = None
-    for bs, flow_teacher in ((2, True), (1, True), (2, False), (1, False)):
+    # the full 512x1024 shape is tried first; the tunneled compile
+    # helper has rejected every 512x1024 vid2vid program (and spade
+    # bs>8) across repeated idle-chip runs, so the sweep degrades to
+    # 256x512 with an honest metric suffix rather than reporting nothing
+    legs = ((2, True, (512, 1024)), (2, True, (256, 512)),
+            (1, True, (256, 512)), (2, False, (256, 512)),
+            (1, False, (256, 512)))
+    for bs, flow_teacher, hw in legs:
         try:
             # drop the previous leg's device state BEFORE building the
             # next trainer — otherwise old + new HBM must coexist and a
@@ -164,9 +184,10 @@ def run_vid2vid(seq_len=4):
                 trainer.state = None
             trainer = data = None
             jax.clear_caches()
-            trainer, label_ch = build_vid2vid(flow_teacher)
+            trainer, label_ch = build_vid2vid(flow_teacher, hw)
             data = jax.device_put(jax.tree_util.tree_map(
-                np.asarray, vid2vid_batch(bs, seq_len, label_ch)))
+                np.asarray,
+                vid2vid_batch(bs, seq_len, label_ch, h=hw[0], w=hw[1])))
             jax.block_until_ready(data)
             trainer.init_state(jax.random.PRNGKey(0), data)
 
@@ -191,7 +212,8 @@ def run_vid2vid(seq_len=4):
             sync()
             dt = time.time() - t0
             frames_per_sec = bs * seq_len * iters / dt
-            metric = "vid2vid_512x1024_train_frames_per_sec_per_chip"
+            metric = (f"vid2vid_{hw[0]}x{hw[1]}_train_frames_per_sec"
+                      "_per_chip")
             if not flow_teacher:
                 metric += "_noteacher"
             payload = {
@@ -205,11 +227,11 @@ def run_vid2vid(seq_len=4):
                 json.dump(dict(payload, batch_size=bs, seq_len=seq_len,
                                flow_teacher=flow_teacher,
                                per_frame_step_ms=round(
-                                   dt * 1e3 / (seq_len * iters), 2)), f,
-                          indent=1)
+                                   dt * 1e3 / (bs * seq_len * iters), 2)),
+                          f, indent=1)
             print(json.dumps(payload))
             return
-        except Exception as e:  # OOM etc. -> halve batch
+        except Exception as e:  # OOM / compiler cap -> next leg
             last_error = e
             continue
     raise SystemExit(f"vid2vid bench failed at all batch sizes: "

@@ -1,12 +1,13 @@
 #!/usr/bin/env python
 """Per-frame attribution for the vid2vid bench leg (VERDICT r3 #5).
 
-Times, on the real chip at the cityscapes bf16.yaml budget (512x1024),
-the interleaved rollout's constituent programs in their steady (warped)
-state: the per-frame D and G step programs, the G apply alone, the
-FlowNet2 teacher forward, and — in a separately-built variant with a
-temporal discriminator enabled — the temporal-D marginal cost. Appends
-a section to PROFILE.md and writes VIDPROFILE.json.
+Times, on the real chip, the cityscapes bf16.yaml recipe at 256x512
+(the largest vid2vid shape the tunneled compiler accepts — 512x1024
+crashes its helper): the per-frame D and G step programs and the G
+apply alone, across three variants — base (FlowNet2 teacher in-graph),
+a no-teacher twin (teacher cost = base - noteacher), and a
+temporal-D-enabled twin (temporal-D marginal). Writes VIDPROFILE.json;
+the narrative lives in PROFILE.md.
 
 Method: the same two-K dispatch-slope timing as profile_bench.py (the
 device queue serializes; constant dispatch/readback cost cancels).
@@ -50,10 +51,13 @@ def measure(call):
     return max(0.0, (times[K_LARGE] - times[K_SMALL]) / (K_LARGE - K_SMALL))
 
 
-def build(with_temporal=False):
+def build(with_temporal=False, flow_teacher=True):
     import bench
 
-    trainer, label_ch = bench.build_vid2vid()
+    # 256x512: the largest vid2vid shape the tunneled compiler accepts
+    # (VIDBENCH.json leg); 512x1024 programs crash its helper
+    trainer, label_ch = bench.build_vid2vid(flow_teacher=flow_teacher,
+                                            hw=(256, 512))
     if with_temporal:
         cfg = trainer.cfg
         cfg.dis.temporal = {"num_scales": 1, "num_filters": 64,
@@ -85,81 +89,92 @@ def warped_frame_data(trainer, data):
 
 
 def main():
+    results = {}
+    # flow-teacher cost is attributed by SUBTRACTION (base - noteacher):
+    # a standalone teacher-forward probe wedges the tunneled device
+    for variant, with_temporal, flow_teacher in (
+            ("base", False, True),
+            ("noteacher", False, False),
+            ("temporalD", True, True)):
+        try:
+            main_variant(variant, with_temporal, flow_teacher, results)
+        except Exception as e:  # noqa: BLE001 - one bad variant
+            print(f"[{variant}] failed entirely: {e!s:.150}", flush=True)
+            results.setdefault(variant, {})
+    finish(results)
+
+
+def main_variant(variant, with_temporal, flow_teacher, results):
     import bench
 
-    results = {}
-    for variant, with_temporal in (("base", False), ("temporalD", True)):
-        trainer, label_ch = build(with_temporal)
-        bs, seq = 2, 4
-        data = jax.device_put(jax.tree_util.tree_map(
-            np.asarray, bench.vid2vid_batch(bs, seq, label_ch)))
-        jax.block_until_ready(data)
-        trainer.init_state(jax.random.PRNGKey(0), data)
-        data_t = warped_frame_data(trainer, data)
-        print(f"[{variant}] profiling at bs={bs} 512x1024 on "
-              f"{jax.devices()[0]}", flush=True)
+    trainer, label_ch = build(with_temporal, flow_teacher)
+    bs, seq = 2, 4
+    data = jax.device_put(jax.tree_util.tree_map(
+        np.asarray, bench.vid2vid_batch(bs, seq, label_ch,
+                                        h=256, w=512)))
+    jax.block_until_ready(data)
+    trainer.init_state(jax.random.PRNGKey(0), data)
+    data_t = warped_frame_data(trainer, data)
+    print(f"[{variant}] profiling at bs={bs} 256x512 on "
+          f"{jax.devices()[0]}", flush=True)
 
-        def dis_frame():
-            trainer.state, _ = trainer._jit_vid_dis(trainer.state, data_t)
-            return trainer.state["vars_D"]["params"]
+    def dis_frame():
+        trainer.state, _ = trainer._jit_vid_dis(trainer.state, data_t)
+        return trainer.state["vars_D"]["params"]
 
-        def gen_frame():
-            trainer.state, _, fake = trainer._jit_vid_gen(trainer.state,
-                                                          data_t)
-            return fake
+    def gen_frame():
+        trainer.state, _, fake = trainer._jit_vid_gen(trainer.state,
+                                                      data_t)
+        return fake
 
-        rng = jax.random.PRNGKey(1)
+    rng = jax.random.PRNGKey(1)
 
-        @jax.jit
-        def g_apply(vars_G, d):
-            out, _ = trainer._apply_G(vars_G, d, rng, training=True)
-            return out["fake_images"]
+    @jax.jit
+    def g_apply(vars_G, d):
+        out, _ = trainer._apply_G(vars_G, d, rng, training=True)
+        return out["fake_images"]
 
-        comp_data = trainer._to_compute_dtype(
-            {k: v for k, v in data_t.items() if k != "past_stacks"})
-        vars_G = trainer._to_compute_dtype(trainer.state["vars_G"])
+    comp_data = trainer._to_compute_dtype(
+        {k: v for k, v in data_t.items() if k != "past_stacks"})
+    vars_G = trainer._to_compute_dtype(trainer.state["vars_G"])
 
-        cases = [("dis_frame_step", dis_frame),
-                 ("gen_frame_step", gen_frame),
-                 ("g_apply_forward", lambda: g_apply(vars_G, comp_data))]
-        if trainer.flow_net_wrapper is not None:
-            fn_params = trainer.state["loss_params"]["flownet"]
-            a = comp_data["image"]
-            b_img = comp_data["real_prev_image"]
+    cases = [("dis_frame_step", dis_frame),
+             ("gen_frame_step", gen_frame),
+             ("g_apply_forward", lambda: g_apply(vars_G, comp_data))]
 
-            @jax.jit
-            def flow_fwd(p, x1, x2):
-                return trainer.flow_net_wrapper._flow_fn(p, x1, x2)[0]
+    out = {}
+    for name, call in cases:
+        try:
+            ms = measure(call)
+            out[name] = round(ms, 2)
+            print(f"  {name}: {ms:.2f} ms", flush=True)
+        except Exception as e:  # noqa: BLE001
+            out[name] = None
+            print(f"  {name}: failed ({e!s:.100})", flush=True)
+    results[variant] = out
+    trainer.state = None
 
-            cases.append(("flownet2_teacher_forward",
-                          lambda: flow_fwd(fn_params, a, b_img)))
 
-        out = {}
-        for name, call in cases:
-            try:
-                ms = measure(call)
-                out[name] = round(ms, 2)
-                print(f"  {name}: {ms:.2f} ms", flush=True)
-            except Exception as e:  # noqa: BLE001
-                out[name] = None
-                print(f"  {name}: failed ({e!s:.100})", flush=True)
-        results[variant] = out
-        trainer.state = None
-
+def finish(results):
     base = results.get("base", {})
+    noteacher = results.get("noteacher", {})
     temp = results.get("temporalD", {})
     derived = {}
-    if base.get("gen_frame_step") and temp.get("gen_frame_step"):
+    if all((base.get("gen_frame_step"), base.get("dis_frame_step"),
+            temp.get("gen_frame_step"), temp.get("dis_frame_step"))):
         derived["temporal_D_marginal_ms (gen+dis, temporalD - base)"] = round(
             (temp["gen_frame_step"] + temp["dis_frame_step"])
             - (base["gen_frame_step"] + base["dis_frame_step"]), 2)
+    if base.get("gen_frame_step") and noteacher.get("gen_frame_step"):
+        derived["flownet2_teacher_marginal_ms (base - noteacher gen)"] = \
+            round(base["gen_frame_step"] - noteacher["gen_frame_step"], 2)
     if base.get("gen_frame_step") and base.get("g_apply_forward"):
         derived["gen_backward+opt_ms (step - apply)"] = round(
             base["gen_frame_step"] - base["g_apply_forward"], 2)
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     payload = {"device": str(jax.devices()[0]), "batch_size": 2,
-               "shape": "512x1024", "components_ms": results,
+               "shape": "256x512", "components_ms": results,
                "derived": derived}
     with open(os.path.join(root, "VIDPROFILE.json"), "w") as f:
         json.dump(payload, f, indent=1)
