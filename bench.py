@@ -248,6 +248,165 @@ def batch_of(bs, label_ch):
     }
 
 
+def _onehot_label(rng, shape, label_ch):
+    lab = np.zeros(shape + (label_ch,), np.float32)
+    idx = rng.randint(0, label_ch, shape)
+    np.put_along_axis(lab, idx[..., None], 1.0, axis=-1)
+    return lab
+
+
+def _sidecar(model, payload, extra):
+    """Record the winning leg in FAMILYBENCH.json keyed by model."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "FAMILYBENCH.json")
+    book = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            book = json.load(f)
+    book[model] = dict(payload, **extra)
+    with open(path, "w") as f:
+        json.dump(book, f, indent=1)
+
+
+def _project_cfg(rel, hw=None, hw_keys=("random_crop_h_w",
+                                        "center_crop_h_w", "resize_h_w")):
+    """Load a shipped project config with random-init weight escapes and
+    an optional spatial override (metric names flag non-native sizes)."""
+    from imaginaire_tpu.config import Config, cfg_get
+
+    cfg = Config(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "configs", "projects", rel))
+    if cfg_get(cfg.trainer, "perceptual_loss", None) is not None:
+        cfg.trainer.perceptual_loss.allow_random_init = True
+        cfg.trainer.perceptual_loss.pop("weights_path", None)
+    if cfg_get(cfg, "flow_network", None) is not None:
+        cfg.flow_network.allow_random_init = True
+        cfg.flow_network.pop("weights_path", None)
+    if hw is not None:
+        hw_str = f"{hw[0]}, {hw[1]}"
+        for split in ("train", "val"):
+            aug = cfg.data[split].augmentations
+            aug.pop("resize_smallest_side", None)
+            for key in hw_keys:
+                aug.pop(key, None)
+            aug.resize_h_w = hw_str
+        if cfg_get(cfg.data, "output_h_w", None) is not None:
+            cfg.data.output_h_w = hw_str
+    return cfg
+
+
+def _family_time(trainer, data, iters):
+    """Warm both step programs, guard finiteness, return seconds/iter."""
+    import jax
+    import jax.numpy as jnp
+
+    for _ in range(2):
+        trainer.dis_update(data)
+        g_losses = trainer.gen_update(data)
+    leaf = jax.tree_util.tree_leaves(trainer.state["vars_G"]["params"])[0]
+    float(jnp.sum(leaf))
+    bad = [k for k, v in g_losses.items()
+           if not np.isfinite(float(jnp.asarray(v)))]
+    if bad:
+        raise SystemExit(f"non-finite losses: {bad}")
+    t0 = time.time()
+    for _ in range(iters):
+        trainer.dis_update(data)
+        trainer.gen_update(data)
+    float(jnp.sum(jax.tree_util.tree_leaves(
+        trainer.state["vars_G"]["params"])[0]))
+    return (time.time() - t0) / iters
+
+
+def run_family(model):
+    """Tracked-config bench legs beyond spade/vid2vid (BASELINE.json:
+    pix2pixHD Cityscapes, MUNIT AFHQ, fs_vid2vid FaceForensics). Each
+    sweeps (bs, hw) down from the faithful recipe shape to what the
+    tunneled compiler accepts; the metric name carries the actual
+    shape. One JSON line; winning leg recorded in FAMILYBENCH.json."""
+    import jax
+    from imaginaire_tpu.registry import resolve
+    from imaginaire_tpu.utils.data import get_paired_input_label_channel_number
+
+    rng = np.random.RandomState(0)
+    if model == "pix2pixHD":
+        rel = "pix2pixHD/cityscapes/bf16.yaml"
+        legs = ((2, (512, 1024)), (1, (512, 1024)), (2, (256, 512)),
+                (1, (256, 512)))
+
+        def make(bs, hw):
+            cfg = _project_cfg(rel, hw if hw != (512, 1024) else None)
+            trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+            n = get_paired_input_label_channel_number(cfg.data)
+            data = {"images": rng.rand(bs, *hw, 3).astype(
+                        np.float32) * 2 - 1,
+                    "label": _onehot_label(rng, (bs,) + hw, n)}
+            return trainer, data, bs
+    elif model == "munit":
+        rel = "munit/afhq_dog2cat/bf16.yaml"
+        legs = ((4, (256, 256)), (2, (256, 256)), (1, (256, 256)))
+
+        def make(bs, hw):
+            cfg = _project_cfg(rel)  # native 256 crop
+            trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+            data = {"images_a": rng.rand(bs, *hw, 3).astype(
+                        np.float32) * 2 - 1,
+                    "images_b": rng.rand(bs, *hw, 3).astype(
+                        np.float32) * 2 - 1}
+            return trainer, data, bs
+    elif model == "fs_vid2vid":
+        rel = "fs_vid2vid/faceForensics/bf16.yaml"
+        seq, K = 4, 1
+        legs = ((3, (512, 512)), (1, (512, 512)), (3, (256, 256)),
+                (1, (256, 256)))
+
+        def make(bs, hw):
+            cfg = _project_cfg(rel, hw if hw != (512, 512) else None)
+            trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+            n = get_paired_input_label_channel_number(cfg.data)
+            lab = _onehot_label(rng, (bs, seq) + hw, n)
+            data = {"images": rng.rand(bs, seq, *hw, 3).astype(
+                        np.float32) * 2 - 1,
+                    "label": lab,
+                    "ref_images": rng.rand(bs, K, *hw, 3).astype(
+                        np.float32) * 2 - 1,
+                    "ref_labels": lab[:, :K]}
+            return trainer, data, bs * seq
+    else:
+        raise SystemExit(f"unknown family {model}")
+
+    last_error = None
+    trainer = None
+    for bs, hw in legs:
+        try:
+            if trainer is not None:
+                trainer.state = None
+            trainer = None
+            jax.clear_caches()
+            trainer, data, units = make(bs, hw)
+            data = jax.device_put(jax.tree_util.tree_map(np.asarray, data))
+            jax.block_until_ready(data)
+            trainer.init_state(jax.random.PRNGKey(0), data)
+            dt = _family_time(trainer, data, iters=6)
+            unit = ("frames/sec/chip" if model == "fs_vid2vid"
+                    else "imgs/sec/chip")
+            payload = {
+                "metric": f"{model}_{hw[0]}x{hw[1]}_train_"
+                          f"{unit.split('/')[0]}_per_sec_per_chip",
+                "value": round(units / dt, 3),
+                "unit": unit,
+                "vs_baseline": None,
+            }
+            _sidecar(model, payload,
+                     {"batch_size": bs, "step_ms": round(dt * 1e3, 2)})
+            print(json.dumps(payload))
+            return
+        except Exception as e:  # OOM / compiler cap -> next leg
+            last_error = e
+            continue
+    raise SystemExit(f"{model} bench failed at all legs: {last_error}")
+
+
 def run(trainer, label_ch, batch_sizes, metric):
     import jax
     import jax.numpy as jnp
@@ -309,14 +468,21 @@ def main():
     parser.add_argument("--width", choices=("zoo", "unit"), default="zoo",
                         help="zoo = faithful nf=128 base128_bs4.yaml budget "
                              "(headline); unit = nf=64 unit-test width")
-    parser.add_argument("--model", choices=("spade", "vid2vid"),
+    parser.add_argument("--model",
+                        choices=("spade", "vid2vid", "pix2pixHD", "munit",
+                                 "fs_vid2vid"),
                         default="spade",
                         help="spade = headline image bench (default); "
-                             "vid2vid = cityscapes 512x1024 interleaved "
-                             "rollout (VIDBENCH.json)")
+                             "vid2vid = cityscapes interleaved rollout "
+                             "(VIDBENCH.json); pix2pixHD/munit/"
+                             "fs_vid2vid = remaining BASELINE-tracked "
+                             "families (FAMILYBENCH.json)")
     args = parser.parse_args()
     if args.model == "vid2vid":
         run_vid2vid()
+        return
+    if args.model in ("pix2pixHD", "munit", "fs_vid2vid"):
+        run_family(args.model)
         return
     if args.width == "zoo":
         trainer, label_ch = build_zoo()
