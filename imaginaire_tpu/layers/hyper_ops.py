@@ -9,26 +9,59 @@ loop disappears into the compiler and the MXU sees full tiles.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 
 
 def _conv2d_single(x, w, stride=1, padding="SAME", dilation=1):
     # x: (H, W, Cin), w: (kh, kw, Cin, Cout)
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    dilation = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
     out = lax.conv_general_dilated(
         x[None],
         w,
-        window_strides=(stride, stride),
+        window_strides=stride,
         padding=padding,
-        rhs_dilation=(dilation, dilation),
+        rhs_dilation=dilation,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
     return out[0]
 
 
+def _vmap_conv2d(x, w, stride, padding, dilation):
+    return jax.vmap(
+        lambda xi, wi: _conv2d_single(xi, wi, stride, padding, dilation))(
+        x, w)
+
+
 def per_sample_conv2d(x, w, b=None, stride=1, padding="SAME", dilation=1):
-    """x: (B, H, W, Cin); w: (B, kh, kw, Cin, Cout); b: (B, Cout) or None."""
-    out = jax.vmap(lambda xi, wi: _conv2d_single(xi, wi, stride, padding, dilation))(x, w)
+    """x: (B, H, W, Cin); w: (B, kh, kw, Cin, Cout); b: (B, Cout) or None.
+
+    XLA lowers the vmap'd per-sample conv to a feature-grouped conv
+    whose groups carry the batch — a form GSPMD cannot partition over a
+    data-sharded batch (feature/group divisibility errors inside the
+    sharded training step). When a process mesh with a >1 'data' axis
+    has been CONFIGURED (peek_mesh — never auto-created from a layer
+    op) and the batch divides it, the conv runs inside a shard_map
+    island (the non_local.py pattern): each device convolves its own
+    batch shard locally and the surrounding jit program keeps its GSPMD
+    shardings."""
+    from imaginaire_tpu.parallel.mesh import peek_mesh
+
+    mesh = peek_mesh()
+    if (mesh is not None and "data" in mesh.axis_names
+            and mesh.shape["data"] > 1
+            and x.shape[0] % mesh.shape["data"] == 0):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        spec = P("data")
+        out = shard_map(
+            lambda xx, ww: _vmap_conv2d(xx, ww, stride, padding,
+                                        dilation),
+            mesh=mesh, in_specs=(spec, spec), out_specs=spec)(x, w)
+    else:
+        out = _vmap_conv2d(x, w, stride, padding, dilation)
     if b is not None:
         out = out + b[:, None, None, :]
     return out
@@ -36,27 +69,14 @@ def per_sample_conv2d(x, w, b=None, stride=1, padding="SAME", dilation=1):
 
 def grouped_modulated_conv2d(x, w, stride=1, padding="SAME", dilation=1):
     """Weight-demodulated conv: per-sample kernels (B, kh, kw, Cin, Cout)
-    applied as one grouped conv (StyleGAN2 trick, ref:
-    layers/weight_norm.py:14-68).
+    (StyleGAN2 modulation, ref: layers/weight_norm.py:14-68).
 
-    Group g of the grouped kernel must hold sample g's filters, so the
-    batch axis lands next to Cout (groups-major channel order) on both
-    the kernel and the output.
+    Delegates to ``per_sample_conv2d``: the explicit StyleGAN2 grouped
+    trick (batch folded into feature_group_count) is GSPMD-hostile, and
+    so is the raw vmap lowering (XLA produces the same grouped form) —
+    per_sample_conv2d's shard_map island is what makes the op partition
+    over a configured 'data' mesh. Keep all per-sample convs routed
+    through that one entry point.
     """
-    b, h, wd, cin = x.shape
-    _, kh, kw, _, cout = w.shape
-    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
-    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
-    x_g = jnp.transpose(x, (1, 2, 0, 3)).reshape(1, h, wd, b * cin)
-    w_g = jnp.transpose(w, (1, 2, 3, 0, 4)).reshape(kh, kw, cin, b * cout)
-    out = lax.conv_general_dilated(
-        x_g,
-        w_g.astype(x.dtype),
-        window_strides=stride,
-        padding=padding,
-        rhs_dilation=dilation,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        feature_group_count=b,
-    )
-    oh, ow = out.shape[1:3]
-    return jnp.transpose(out.reshape(oh, ow, b, cout), (2, 0, 1, 3))
+    return per_sample_conv2d(x, w.astype(x.dtype), stride=stride,
+                             padding=padding, dilation=dilation)

@@ -92,6 +92,16 @@ def get_mesh():
     return _GLOBAL_MESH
 
 
+def peek_mesh():
+    """The configured process mesh, or None — WITHOUT creating one.
+
+    Layer-level sharding opt-ins (e.g. hyper_ops' data-sharded
+    per-sample conv) must consult the mesh passively: get_mesh()'s
+    auto-create would silently install a global all-device mesh as a
+    side effect of a layer op in programs that never called set_mesh."""
+    return _GLOBAL_MESH
+
+
 def get_rank():
     """Host-process index (ref: utils/distributed.py:20-26)."""
     return jax.process_index()

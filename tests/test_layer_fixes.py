@@ -16,22 +16,71 @@ from imaginaire_tpu.layers.activation_norm import get_activation_norm_layer
 from imaginaire_tpu.layers.hyper_ops import grouped_modulated_conv2d, per_sample_conv2d
 
 
+def _reference_per_sample_conv(x, kernels, stride=1, padding="SAME",
+                               dilation=1):
+    """Independent oracle: an explicit python loop of single-sample
+    convs — what the reference's per-sample F.conv2d loop computes
+    (ref: layers/conv.py:545-590). Both production entry points
+    (per_sample_conv2d and its grouped_modulated delegate) must match
+    this, whatever lowering they use internally."""
+    from jax import lax
+
+    outs = []
+    for i in range(x.shape[0]):
+        outs.append(lax.conv_general_dilated(
+            x[i:i + 1], kernels[i],
+            window_strides=(stride, stride), padding=padding,
+            rhs_dilation=(dilation, dilation),
+            dimension_numbers=("NHWC", "HWIO", "NHWC")))
+    return jnp.concatenate(outs, axis=0)
+
+
 def test_grouped_modulated_matches_per_sample(key, rng):
     b, h, w, cin, cout, k = 3, 8, 8, 4, 6, 3
     x = jnp.asarray(rng.randn(b, h, w, cin).astype(np.float32))
     kernels = jnp.asarray(rng.randn(b, k, k, cin, cout).astype(np.float32))
-    got = grouped_modulated_conv2d(x, kernels, padding="SAME")
-    want = per_sample_conv2d(x, kernels, padding="SAME")
-    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    want = _reference_per_sample_conv(x, kernels, padding="SAME")
+    for fn in (grouped_modulated_conv2d, per_sample_conv2d):
+        got = fn(x, kernels, padding="SAME")
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
 def test_grouped_modulated_stride_and_dilation(key, rng):
     b, h, w, cin, cout, k = 2, 8, 8, 3, 5, 3
     x = jnp.asarray(rng.randn(b, h, w, cin).astype(np.float32))
     kernels = jnp.asarray(rng.randn(b, k, k, cin, cout).astype(np.float32))
-    got = grouped_modulated_conv2d(x, kernels, stride=2, padding="SAME", dilation=2)
-    want = per_sample_conv2d(x, kernels, stride=2, padding="SAME", dilation=2)
-    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    want = _reference_per_sample_conv(x, kernels, stride=2, padding="SAME",
+                                      dilation=2)
+    for fn in (grouped_modulated_conv2d, per_sample_conv2d):
+        got = fn(x, kernels, stride=2, padding="SAME", dilation=2)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_per_sample_conv_sharded_island_matches(rng):
+    """With a configured >1-device 'data' mesh the conv runs in a
+    shard_map island — its output must equal the unsharded oracle (and
+    the mesh must NEVER be auto-created by the layer op: peek, not
+    get)."""
+    from imaginaire_tpu.parallel import mesh as mesh_mod
+    from imaginaire_tpu.parallel.mesh import create_mesh, set_mesh
+
+    b, h, w, cin, cout, k = 8, 8, 8, 3, 5, 3
+    x = jnp.asarray(rng.randn(b, h, w, cin).astype(np.float32))
+    kernels = jnp.asarray(rng.randn(b, k, k, cin, cout).astype(np.float32))
+    want = _reference_per_sample_conv(x, kernels)
+    old = mesh_mod._GLOBAL_MESH
+    try:
+        set_mesh(None)
+        # no configured mesh: the layer op must not install one
+        got_plain = per_sample_conv2d(x, kernels)
+        assert mesh_mod._GLOBAL_MESH is None
+        np.testing.assert_allclose(got_plain, want, rtol=1e-4, atol=1e-4)
+        set_mesh(create_mesh(("data",), (8,)))
+        got_sharded = jax.jit(lambda a, b_: per_sample_conv2d(a, b_))(
+            x, kernels)
+        np.testing.assert_allclose(got_sharded, want, rtol=1e-4, atol=1e-4)
+    finally:
+        set_mesh(old)
 
 
 def test_spectral_apply_without_mutable_collection(key, rng):
