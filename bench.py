@@ -354,6 +354,23 @@ def run_family(model):
                     "images_b": rng.rand(bs, *hw, 3).astype(
                         np.float32) * 2 - 1}
             return trainer, data, bs
+    elif model == "funit":
+        rel = "funit/animal_faces/base64_bs8_class119.yaml"
+        legs = ((8, (256, 256)), (4, (256, 256)), (1, (256, 256)))
+
+        def make(bs, hw):
+            cfg = _project_cfg(rel)  # native 256 crop
+            trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+            n_cls = int(cfg.dis.num_classes)
+            data = {"images_content": rng.rand(bs, *hw, 3).astype(
+                        np.float32) * 2 - 1,
+                    "images_style": rng.rand(bs, *hw, 3).astype(
+                        np.float32) * 2 - 1,
+                    "labels_content": rng.randint(
+                        0, n_cls, (bs,)).astype(np.int32),
+                    "labels_style": rng.randint(
+                        0, n_cls, (bs,)).astype(np.int32)}
+            return trainer, data, bs
     elif model == "fs_vid2vid":
         rel = "fs_vid2vid/faceForensics/bf16.yaml"
         seq, K = 4, 1
@@ -470,7 +487,7 @@ def main():
                              "(headline); unit = nf=64 unit-test width")
     parser.add_argument("--model",
                         choices=("spade", "vid2vid", "pix2pixHD", "munit",
-                                 "fs_vid2vid"),
+                                 "funit", "fs_vid2vid"),
                         default="spade",
                         help="spade = headline image bench (default); "
                              "vid2vid = cityscapes interleaved rollout "
@@ -481,7 +498,7 @@ def main():
     if args.model == "vid2vid":
         run_vid2vid()
         return
-    if args.model in ("pix2pixHD", "munit", "fs_vid2vid"):
+    if args.model in ("pix2pixHD", "munit", "funit", "fs_vid2vid"):
         run_family(args.model)
         return
     if args.width == "zoo":
