@@ -424,6 +424,141 @@ def run_family(model):
     raise SystemExit(f"{model} bench failed at all legs: {last_error}")
 
 
+def _ensure_packed_fixture(n_imgs=64, side=288):
+    """Synthesize a COCO-Stuff-shaped packed-shard fixture once per
+    process cache: jpg images + png class-index seg maps (blocky, with
+    dont-care speckle) + png edge maps, packed by
+    data/backends.build_packed_dataset (SURVEY §7 hard-part #6)."""
+    import shutil
+
+    import cv2
+
+    base = "/tmp/imaginaire_tpu_bench_data"
+    raw = os.path.join(base, "raw")
+    packed = os.path.join(base, "packed")
+    stamp = os.path.join(packed, f".stamp_{n_imgs}_{side}")
+    if os.path.exists(stamp):
+        return packed
+    shutil.rmtree(base, ignore_errors=True)
+    rng = np.random.RandomState(0)
+    for i in range(n_imgs):
+        seq = f"seq{i // 16:03d}"
+        stem = f"{i:06d}"
+        dirs = {t: os.path.join(raw, t, seq)
+                for t in ("images", "seg_maps", "edge_maps")}
+        for d in dirs.values():
+            os.makedirs(d, exist_ok=True)
+        img = rng.randint(0, 256, (side, side, 3)).astype(np.uint8)
+        cv2.imwrite(os.path.join(dirs["images"], stem + ".jpg"), img,
+                    [cv2.IMWRITE_JPEG_QUALITY, 90])
+        # blocky class maps: real seg labels are piecewise-constant, and
+        # pixel noise would make the png decode cost unrealistically high
+        blocks = rng.randint(0, 183, (side // 16 + 1, side // 16 + 1))
+        seg = np.repeat(np.repeat(blocks, 16, 0), 16, 1)[:side, :side]
+        seg = seg.astype(np.uint8)
+        seg[rng.rand(side, side) < 0.02] = 255  # dont-care speckle
+        cv2.imwrite(os.path.join(dirs["seg_maps"], stem + ".png"), seg)
+        edge = cv2.Canny(seg, 1, 1)
+        cv2.imwrite(os.path.join(dirs["edge_maps"], stem + ".png"), edge)
+    from imaginaire_tpu.data.backends import build_packed_dataset
+
+    build_packed_dataset(raw, packed, ["images", "seg_maps", "edge_maps"])
+    open(stamp, "w").close()
+    return packed
+
+
+def run_pipeline_fed():
+    """SPADE zoo step fed by the REAL input pipeline — packed-shard
+    backend -> augmentor -> threaded loader -> device — vs the synthetic
+    pre-built-batch number at the same batch size (VERDICT r4 #3).
+
+    Uses the zoo config's own data section (8 workers, is_packed,
+    resize/scale/flip/crop augmentations) plus ``one_hot_on_device``:
+    the host ships (B,256,256) int seg maps + (B,256,256,1) edge maps
+    and the device one-hot expands (the 48MB/img host one-hot transfer
+    would otherwise dominate any tunnel/PCIe link). Prints the
+    pipeline-fed JSON line; writes both numbers + delta to
+    DATABENCH.json."""
+    import jax
+    import jax.numpy as jnp
+
+    from imaginaire_tpu.config import Config
+    from imaginaire_tpu.data.loader import get_train_and_val_dataloader
+    from imaginaire_tpu.registry import resolve
+    from imaginaire_tpu.utils.data import get_paired_input_label_channel_number
+
+    packed = _ensure_packed_fixture()
+    cfg = Config(ZOO_CONFIG)
+    cfg.trainer.perceptual_loss.allow_random_init = True
+    cfg.trainer.perceptual_loss.pop("weights_path", None)
+    cfg.data.one_hot_on_device = True
+    for split in ("train", "val"):
+        cfg.data[split].roots = [packed]
+        cfg.data[split].is_packed = True
+    bs = int(cfg.data.train.batch_size)
+    label_ch = get_paired_input_label_channel_number(cfg.data)
+    trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+    train_loader, _ = get_train_and_val_dataloader(cfg)
+
+    def steps(data, n, sync=True):
+        for _ in range(n):
+            trainer.dis_update(data)
+            g_losses = trainer.gen_update(data)
+        if sync:
+            float(jnp.sum(jax.tree_util.tree_leaves(
+                trainer.state["vars_G"]["params"])[0]))
+        return g_losses
+
+    def batches():
+        epoch = 0
+        while True:
+            train_loader.set_epoch(epoch)
+            for raw in train_loader:
+                yield trainer.start_of_iteration(raw, 0)
+            epoch += 1
+
+    feed = batches()
+    first = next(feed)
+    trainer.init_state(jax.random.PRNGKey(0), first)
+    g_losses = steps(first, 2)  # compile + warm
+    bad = [k for k, v in g_losses.items()
+           if not np.isfinite(float(jnp.asarray(v)))]
+    if bad:
+        raise SystemExit(f"non-finite losses (pipeline leg): {bad}")
+    iters = 10
+    t0 = time.time()
+    for _ in range(iters):
+        steps(next(feed), 1, sync=False)
+    float(jnp.sum(jax.tree_util.tree_leaves(
+        trainer.state["vars_G"]["params"])[0]))
+    pipe_rate = bs * iters / (time.time() - t0)
+
+    # synthetic twin: same trainer, same bs, pre-built device-resident
+    # batch (the headline bench's feeding mode)
+    data = jax.device_put(
+        jax.tree_util.tree_map(np.asarray, batch_of(bs, label_ch)))
+    jax.block_until_ready(data)
+    steps(data, 2)
+    t0 = time.time()
+    steps(data, iters)
+    synth_rate = bs * iters / (time.time() - t0)
+
+    delta_pct = (synth_rate - pipe_rate) / synth_rate * 100.0
+    payload = {
+        "metric": "spade_256_train_imgs_per_sec_per_chip_pipeline_fed",
+        "value": round(pipe_rate, 3),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": round(pipe_rate / V100_IMGS_PER_SEC, 3),
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "DATABENCH.json"), "w") as f:
+        json.dump(dict(payload, batch_size=bs,
+                       synthetic_imgs_per_sec=round(synth_rate, 3),
+                       pipeline_overhead_pct=round(delta_pct, 2),
+                       num_workers=int(cfg.data.num_workers)), f, indent=1)
+    print(json.dumps(payload))
+
+
 def run(trainer, label_ch, batch_sizes, metric):
     import jax
     import jax.numpy as jnp
@@ -485,6 +620,12 @@ def main():
     parser.add_argument("--width", choices=("zoo", "unit"), default="zoo",
                         help="zoo = faithful nf=128 base128_bs4.yaml budget "
                              "(headline); unit = nf=64 unit-test width")
+    parser.add_argument("--data", choices=("synthetic", "packed"),
+                        default="synthetic",
+                        help="synthetic = pre-built device batch (headline); "
+                             "packed = feed the SPADE zoo step from the "
+                             "real packed-shard backend->augmentor->loader "
+                             "pipeline and record the delta (DATABENCH.json)")
     parser.add_argument("--model",
                         choices=("spade", "vid2vid", "pix2pixHD", "munit",
                                  "funit", "fs_vid2vid"),
@@ -495,6 +636,11 @@ def main():
                              "fs_vid2vid = remaining BASELINE-tracked "
                              "families (FAMILYBENCH.json)")
     args = parser.parse_args()
+    if args.data == "packed":
+        if args.model != "spade":
+            raise SystemExit("--data packed is the SPADE pipeline leg")
+        run_pipeline_fed()
+        return
     if args.model == "vid2vid":
         run_vid2vid()
         return

@@ -74,6 +74,24 @@ class BaseDataset:
             self.is_mask[name] = cfg_get(info, "is_mask", False)
             self.pre_aug_ops[name] = _parse_ops(cfg_get(info, "pre_aug_ops", "None"))
             self.post_aug_ops[name] = _parse_ops(cfg_get(info, "post_aug_ops", "None"))
+        # TPU-native label path: ship (H,W) int index maps to the device
+        # and one-hot there (trainers/base._expand_labels) instead of
+        # building ~num_channels x float32 one-hot tensors on the host —
+        # for COCO-Stuff's 183 classes that is a 0.3MB vs 48MB per-image
+        # host->device transfer (SURVEY.md §7 hard-part #6).
+        self.one_hot_on_device = bool(
+            cfg_get(self.cfgdata, "one_hot_on_device", False))
+        if self.one_hot_on_device and (
+                self.supports_temporal_stride
+                or "video" in str(cfg_get(self.cfgdata, "type", ""))):
+            # video trainers fold past labels into channels on the host
+            # (trainers/vid2vid._start_of_iteration) — int maps would
+            # silently skip that path, so refuse rather than mis-train.
+            # The type-name check also catches video datasets that don't
+            # implement temporal striding (paired_few_shot_videos_native).
+            raise ValueError(
+                "one_hot_on_device is implemented for image datasets "
+                "only; drop the knob for video dataset types")
         self.input_labels = list(cfg_get(self.cfgdata, "input_labels", None) or [])
         self.input_image = list(cfg_get(self.cfgdata, "input_image", None) or [])
         self.keypoint_data_types = list(
@@ -232,8 +250,13 @@ class BaseDataset:
                                        and arr.shape[-1] == 1
                                        and self.num_channels[t] > 1
                                        and not vis_output):
-                    arr = self._encode_onehot(
-                        arr, self.num_channels[t], self.use_dont_care[t])
+                    if self.one_hot_on_device and self.is_mask[t] \
+                            and t in self.input_labels:
+                        arr = self._encode_index_map(
+                            arr, self.num_channels[t])
+                    else:
+                        arr = self._encode_onehot(
+                            arr, self.num_channels[t], self.use_dont_care[t])
                 else:
                     if was_uint8[t]:
                         arr = arr / 255.0
@@ -243,6 +266,17 @@ class BaseDataset:
             out[t] = np.stack(frames, axis=0)
         out["is_flipped"] = np.asarray(is_flipped)
         return out
+
+    @staticmethod
+    def _encode_index_map(label_map, num_labels):
+        """(H,W,1) -> (H,W,1) int32 with the same out-of-range mapping as
+        ``_encode_onehot`` (OOR/negative -> dont-care index num_labels);
+        the device-side ``jax.nn.one_hot`` then reproduces the host
+        encoding exactly (a dropped dont-care channel falls out as the
+        all-zero row one_hot gives out-of-range indices)."""
+        idx = label_map[..., :1].astype(np.int32)
+        idx[(idx < 0) | (idx >= num_labels)] = num_labels
+        return idx
 
     @staticmethod
     def _encode_onehot(label_map, num_labels, use_dont_care):
@@ -258,14 +292,39 @@ class BaseDataset:
         return out
 
     def concat_labels(self, out, squeeze_time=False):
-        """(ref: paired_videos.py:276-283)."""
-        if self.input_labels:
+        """(ref: paired_videos.py:276-283).
+
+        With ``one_hot_on_device`` the single mask label type stays an
+        int index map under ``label`` (channel dim dropped; the trainer
+        one-hot expands it on device) and any remaining float label
+        types concatenate under ``label_float`` — the trainer appends
+        them after the device-side one-hot, preserving the reference's
+        label channel order (mask channels first)."""
+        if self.input_labels and self.one_hot_on_device:
+            mask_types = [t for t in self.input_labels if self.is_mask[t]]
+            if len(mask_types) != 1:
+                raise ValueError(
+                    "one_hot_on_device needs exactly one mask label type, "
+                    f"got {mask_types} — disable the knob for this config")
+            if mask_types[0] != self.input_labels[0]:
+                raise ValueError(
+                    "one_hot_on_device requires the mask label type first "
+                    "in input_labels (channel-order contract)")
+            idx = out.pop(mask_types[0])
+            out["label"] = idx[..., 0]  # (T,H,W) int32
+            floats = [out.pop(t) for t in self.input_labels
+                      if t != mask_types[0]]
+            if floats:
+                out["label_float"] = np.concatenate(floats, axis=-1)
+        elif self.input_labels:
             labels = [out.pop(t) for t in self.input_labels]
             out["label"] = np.concatenate(labels, axis=-1)
         if squeeze_time:
             for k in list(out.keys()):
                 v = out[k]
-                if isinstance(v, np.ndarray) and v.ndim >= 4:
+                min_ndim = 3 if (k == "label" and self.one_hot_on_device) \
+                    else 4  # int index maps carry no channel dim
+                if isinstance(v, np.ndarray) and v.ndim >= min_ndim:
                     out[k] = v[0] if v.shape[0] == 1 else v
         return out
 

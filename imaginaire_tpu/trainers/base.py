@@ -339,6 +339,8 @@ class BaseTrainer:
         if current_iteration % cfg_get(cfg, "logging_iter", 100) == 0:
             self._meter("time/iteration").write(self.time_iteration)
             self._flush_meters(current_iteration)
+            if cfg_get(cfg.trainer, "log_weight_stats", False):
+                self._write_weight_stats(current_iteration)
         if current_iteration % cfg_get(cfg, "snapshot_save_iter", 10000) == 0:
             self.save_checkpoint(current_epoch, current_iteration)
             self.write_metrics()
@@ -355,6 +357,20 @@ class BaseTrainer:
         if current_epoch % cfg_get(self.cfg, "snapshot_save_epoch", 20) == 0:
             self.save_checkpoint(current_epoch, current_iteration)
             self.write_metrics()
+
+    def _write_weight_stats(self, step):
+        """Spectral-norm σ/weight-norm stats per logging interval
+        (ref: utils/meters.py:19-51, get_weight_stats — the reference
+        ships it unwired; enable via trainer.log_weight_stats)."""
+        from imaginaire_tpu.utils.meters import write_weight_stats
+
+        for net_key, prefix in (("vars_G", "weights/G"),
+                                ("vars_D", "weights/D")):
+            tree = (self.state or {}).get(net_key)
+            if tree and tree.get("spectral"):
+                write_weight_stats(prefix,
+                                   jax.device_get(tree["params"]),
+                                   jax.device_get(tree["spectral"]), step)
 
     # subclass extension points (ref: base.py:481-585)
     def _start_of_epoch(self, current_epoch):
@@ -481,7 +497,9 @@ class BaseTrainer:
         meta = {"epoch": current_epoch, "iteration": current_iteration}
         path = ckpt_lib.save_checkpoint(
             logdir, {"state": self.state, "meta": meta},
-            current_epoch, current_iteration)
+            current_epoch, current_iteration,
+            async_save=bool(cfg_get(self.cfg.trainer, "async_checkpoint",
+                                    False)))
         # Recalibrated EMA BN stats ride alongside (a sibling file keeps
         # the state tree's structure stable across checkpoint versions);
         # the reference persists them inside the averaged model's buffers.
@@ -498,6 +516,8 @@ class BaseTrainer:
         """(ref: base.py:210-265): explicit path = weights-only unless
         resume=True; pointer-file discovery = resume."""
         logdir = cfg_get(self.cfg, "logdir", ".")
+        # an in-flight async save must commit before we read anything back
+        ckpt_lib.wait_for_pending_checkpoint()
         if checkpoint_path is None:
             checkpoint_path = ckpt_lib.latest_checkpoint_path(logdir)
             if checkpoint_path is None:

@@ -78,8 +78,19 @@ class Trainer(BaseTrainer):
         from imaginaire_tpu.utils.data import get_paired_input_label_channel_number
 
         n = get_paired_input_label_channel_number(self.cfg.data)
+        extra = data.get("label_float")
+        if extra is not None:
+            # datasets with one_hot_on_device ship non-mask label types
+            # (e.g. COCO edge maps) separately; they occupy the trailing
+            # channels, mask one-hot first (data/base.concat_labels)
+            n = n - extra.shape[-1]
         onehot = jax.nn.one_hot(label, n, dtype=self.compute_dtype)
-        return dict(data, label=onehot)
+        if extra is not None:
+            onehot = jnp.concatenate(
+                [onehot, extra.astype(onehot.dtype)], axis=-1)
+        out = dict(data, label=onehot)
+        out.pop("label_float", None)
+        return out
 
     def _init_data(self, data):
         return self._expand_labels(
@@ -167,7 +178,9 @@ class Trainer(BaseTrainer):
 
         base = self.base
         out = dict(data)
-        for key in ("label", "images"):
+        # label_float rides alongside int label maps (one_hot_on_device
+        # datasets) and must stay spatially aligned for the device concat
+        for key in ("label", "images", "label_float"):
             if key in out:
                 arr = np.asarray(out[key])
                 h, w = arr.shape[1:3]

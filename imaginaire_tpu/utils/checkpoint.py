@@ -1,12 +1,28 @@
 """Checkpoint save/load with the reference's pointer-file contract
 (ref: imaginaire/trainers/base.py:199-265, 790-829; SURVEY.md §5.4).
 
-orbax handles the array serialization (async-capable, preemption-safe —
-the idiomatic TPU upgrade over torch.save); the surrounding protocol is
-kept bit-compatible in spirit:
+orbax handles the array serialization; the surrounding protocol is kept
+bit-compatible in spirit:
   - checkpoints at ``<logdir>/epoch_EEEEE_iteration_IIIIIIIII_checkpoint``
   - ``<logdir>/latest_checkpoint.txt`` holds the latest checkpoint name
   - resume mode restores everything; weights-only mode restores params
+
+Multi-host contract (the reference master-gates torch.save,
+ref: trainers/base.py:790-829): ``save_checkpoint`` must be called by
+EVERY process with the (possibly non-fully-addressable) sharded state —
+it hands the live ``jax.Array`` pytree to orbax, whose save is a
+collective: each host serializes only the shards it owns and the
+coordinator commits the checkpoint atomically. The pointer file is
+written by the master process only, after the commit. ``device_get`` is
+deliberately NOT used here: it would materialize the full state on every
+host (and raises for non-addressable arrays on real multi-host slices).
+
+``async_save=True`` uses ``ocp.AsyncCheckpointer``: serialization runs
+in a background thread after a device barrier, so training resumes
+immediately (preemption-safe: an interrupted async save leaves only a
+tmp dir, never a half-committed checkpoint — the pointer still names the
+previous complete one). Call ``wait_for_pending_checkpoint()`` before
+reading the checkpoint back or exiting the process.
 """
 
 from __future__ import annotations
@@ -14,12 +30,26 @@ from __future__ import annotations
 import os
 import re
 
-import jax
 import orbax.checkpoint as ocp
 
 from imaginaire_tpu.parallel.mesh import is_master
 
 _POINTER = "latest_checkpoint.txt"
+
+# Lazily-built singleton: AsyncCheckpointer owns a thread pool + barrier
+# state, so one per process, reused across saves.
+_ASYNC_CKPT = None
+# The one in-flight pointer-writer thread (see save_checkpoint): joined
+# by wait_for_pending_checkpoint so pointer writes can never interleave
+# across saves or be lost at process exit.
+_POINTER_THREAD = None
+
+
+def _async_checkpointer():
+    global _ASYNC_CKPT
+    if _ASYNC_CKPT is None:
+        _ASYNC_CKPT = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+    return _ASYNC_CKPT
 
 
 def checkpoint_name(epoch, iteration):
@@ -33,16 +63,71 @@ def parse_checkpoint_name(name):
     return int(m.group(1)), int(m.group(2))
 
 
-def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None):
-    """Master-writes state pytree + pointer file (ref: base.py:790-829)."""
+def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None,
+                    async_save=False):
+    """Collective save of the sharded state + master-only pointer write.
+
+    Every process passes its live state pytree; orbax writes each array
+    shard from the host that owns it (ref contract: base.py:790-829).
+    With ``async_save`` the call returns as soon as device arrays are
+    snapshotted; the pointer is then written by a completion callback so
+    it never names an uncommitted checkpoint.
+    """
     name = checkpoint_name(epoch, iteration)
     path = os.path.abspath(os.path.join(logdir, name))
-    with ocp.PyTreeCheckpointer() as ckpt:
-        ckpt.save(path, jax.device_get(state))
-    if is_master():
-        with open(os.path.join(logdir, _POINTER), "w") as f:
-            f.write(name + "\n")
+    # commit any in-flight async save first: back-to-back saves would
+    # otherwise race the existence check below (orbax also serializes
+    # saves internally, so this costs nothing extra)
+    wait_for_pending_checkpoint()
+
+    def _write_pointer():
+        if is_master():
+            with open(os.path.join(logdir, _POINTER), "w") as f:
+                f.write(name + "\n")
+
+    if os.path.exists(path):
+        # idempotent per (epoch, iteration): the final-iteration save and
+        # a coinciding snapshot_save_iter save name the same state; orbax
+        # refuses to overwrite a committed checkpoint, and the reference's
+        # torch.save overwrite would be a no-op here anyway. Still (re)write
+        # the pointer — a crash between a past commit and its pointer write
+        # must not leave the newer checkpoint unnamed forever.
+        print(f"Checkpoint {name} already exists; skipping duplicate save")
+        _write_pointer()
+        return path
+
+    if async_save:
+        global _POINTER_THREAD
+        ckpt = _async_checkpointer()
+        ckpt.save(path, state)
+        # orbax finalizes the save (tmp-dir rename) on its background
+        # thread; queue the pointer write behind that commit so readers
+        # never observe pointer-before-commit. The thread handle is kept
+        # so wait_for_pending_checkpoint can join it — otherwise a later
+        # save's pointer could be overwritten by this older thread, or
+        # the write lost at process exit.
+        import threading
+
+        _POINTER_THREAD = threading.Thread(
+            target=lambda: (ckpt.wait_until_finished(), _write_pointer()),
+            daemon=True)
+        _POINTER_THREAD.start()
+    else:
+        with ocp.PyTreeCheckpointer() as ckpt:
+            ckpt.save(path, state)
+        _write_pointer()
     return path
+
+
+def wait_for_pending_checkpoint():
+    """Block until any in-flight async save has committed AND its
+    pointer write has landed."""
+    global _POINTER_THREAD
+    if _ASYNC_CKPT is not None:
+        _ASYNC_CKPT.wait_until_finished()
+    if _POINTER_THREAD is not None:
+        _POINTER_THREAD.join()
+        _POINTER_THREAD = None
 
 
 def latest_checkpoint_path(logdir):
@@ -57,8 +142,17 @@ def latest_checkpoint_path(logdir):
 
 
 def load_checkpoint(path, target=None):
-    """Restore a state pytree; ``target`` gives structure/dtypes."""
+    """Restore a state pytree; ``target`` gives structure/dtypes.
+
+    Arrays come back as host numpy; callers ``device_put`` them with
+    their own shardings (trainers re-shard on resume). This keeps
+    restore layout-agnostic — a checkpoint written on one mesh shape
+    loads on another.
+    """
+    import jax
+
     with ocp.PyTreeCheckpointer() as ckpt:
         if target is not None:
-            return ckpt.restore(os.path.abspath(path), item=jax.device_get(target))
+            return ckpt.restore(os.path.abspath(path),
+                                item=jax.device_get(target))
         return ckpt.restore(os.path.abspath(path))

@@ -92,3 +92,72 @@ class Meter:
         if finite:
             write_summary(self.name, sum(finite) / len(finite), step)
         self.reset()
+
+
+def get_weight_stats(params, spectral, grads=None, eps=1e-12):
+    """Spectral-norm weight statistics (ref: imaginaire/utils/meters.py:19-51).
+
+    The reference computes, per spectrally-normalized layer, the raw
+    weight norm, the gradient norm, and the power-iteration sigma
+    estimate ``u^T W v`` (it ships this helper unwired; here it is also
+    reachable from the trainer via ``trainer.log_weight_stats``).
+
+    Args:
+        params: a 'params' pytree (dicts of arrays).
+        spectral: the matching 'spectral' collection (dicts holding 'u'
+            leaves at the layer paths that carry spectral norm).
+        grads: optional gradient pytree with params' structure.
+    Returns:
+        dict mapping 'path/to/layer' -> {'weight_norm', 'sigma',
+        'grad_norm' (0.0 when grads is None)}.
+    """
+    import numpy as np
+
+    stats = {}
+
+    def walk(spec_node, path):
+        if not isinstance(spec_node, dict):
+            return
+        if "u" in spec_node and not isinstance(spec_node["u"], dict):
+            pnode = params
+            gnode = grads
+            for k in path:
+                pnode = pnode.get(k, {}) if isinstance(pnode, dict) else {}
+                if gnode is not None:
+                    gnode = gnode.get(k, {}) if isinstance(gnode, dict) else {}
+            kernel = pnode.get("kernel") if isinstance(pnode, dict) else None
+            if kernel is None:
+                return
+            # host numpy throughout: callers pass device_get'd trees and
+            # a per-layer device round-trip per logging interval would be
+            # pure waste
+            u = np.asarray(spec_node["u"])
+            w = np.asarray(kernel)
+            # same matrix view as layers/weight_norm.py: (out, rest)
+            w_mat = w.reshape(-1, w.shape[-1]).T
+            v = w_mat.T @ u
+            v = v / (np.linalg.norm(v) + eps)
+            sigma = u @ (w_mat @ v)
+            entry = {
+                "weight_norm": float(np.linalg.norm(w)),
+                "sigma": float(sigma),
+                "grad_norm": 0.0,
+            }
+            gk = gnode.get("kernel") if isinstance(gnode, dict) else None
+            if gk is not None:
+                entry["grad_norm"] = float(np.linalg.norm(np.asarray(gk)))
+            stats["/".join(path)] = entry
+        for k, v in spec_node.items():
+            if isinstance(v, dict):
+                walk(v, path + [k])
+
+    walk(spectral, [])
+    return stats
+
+
+@master_only
+def write_weight_stats(prefix, params, spectral, step, grads=None):
+    """Log per-layer spectral stats as TB scalars (ref: meters.py:31-51)."""
+    for layer, entry in get_weight_stats(params, spectral, grads).items():
+        for stat, value in entry.items():
+            write_summary(f"{prefix}/{layer}/{stat}", value, step)

@@ -322,3 +322,54 @@ class TestTemporalStride:
         cfg.data.train.augmentations.max_time_step = 2
         with pytest.raises(ValueError, match="max_time_step"):
             PairedImages(cfg)
+
+
+class TestOneHotOnDevice:
+    """one_hot_on_device: the host ships int index maps + float extras
+    and the trainer's device-side one-hot must reproduce the host
+    encoding exactly (data/base.py::_encode_index_map,
+    trainers/spade.py::_expand_labels)."""
+
+    def _pair(self, cfg):
+        cfg.data.val.augmentations = {"center_crop_h_w": "256, 256"}
+        host = PairedImages(cfg, is_inference=True)
+        cfg.data.one_hot_on_device = True
+        dev = PairedImages(cfg, is_inference=True)
+        return host[0], dev[0]
+
+    def test_matches_host_onehot(self, cfg):
+        import jax.numpy as jnp
+
+        a, b = self._pair(cfg)
+        assert b["label"].dtype == np.int32
+        assert b["label"].shape == (256, 256)
+        assert b["label_float"].shape == (256, 256, 1)
+        # device-side expansion: 13 = 12 seg + dont-care
+        onehot = np.asarray(jnp.asarray(
+            np.eye(13, dtype=np.float32)[b["label"]]))
+        recombined = np.concatenate([onehot, b["label_float"]], axis=-1)
+        np.testing.assert_array_equal(recombined, a["label"])
+
+    def test_trainer_expand_labels_parity(self, cfg):
+        """End-to-end through the SPADE trainer's _expand_labels."""
+        import jax
+        from imaginaire_tpu.registry import resolve
+
+        a, b = self._pair(cfg)
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        data = {"label": jax.numpy.asarray(b["label"][None]),
+                "label_float": jax.numpy.asarray(b["label_float"][None])}
+        out = trainer._expand_labels(data)
+        assert "label_float" not in out
+        np.testing.assert_allclose(np.asarray(out["label"]),
+                                   a["label"][None], atol=1e-6)
+
+    def test_video_types_refuse_knob(self):
+        from imaginaire_tpu.data.paired_videos import Dataset as PairedVideos
+
+        cfg = Config(os.path.join(os.path.dirname(__file__), "..", "configs",
+                                  "unit_test", "vid2vid_street.yaml"))
+        cfg.data.train.roots = [FIXTURES]
+        cfg.data.one_hot_on_device = True
+        with pytest.raises(ValueError, match="image datasets only"):
+            PairedVideos(cfg)

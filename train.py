@@ -90,9 +90,18 @@ def main():
             if current_iteration >= max_iter:
                 print("Done with training!!!")
                 trainer.save_checkpoint(epoch, current_iteration)
+                _drain_checkpoints()
                 return
         trainer.end_of_epoch(data, epoch, current_iteration)
     print("Done with training!!!")
+    _drain_checkpoints()
+
+
+def _drain_checkpoints():
+    """Async checkpoint saves must commit before the process exits."""
+    from imaginaire_tpu.utils.checkpoint import wait_for_pending_checkpoint
+
+    wait_for_pending_checkpoint()
 
 
 if __name__ == "__main__":
