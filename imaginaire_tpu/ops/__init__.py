@@ -8,10 +8,13 @@ Reference ops (ref: imaginaire/third_party/):
 Each op has a pure-jnp implementation (differentiable; XLA autodiff turns
 the gather-style forward into the scatter-add backward the CUDA code does
 with atomicAdd) and a Pallas TPU kernel reachable via
-``implementation='pallas'``. ``implementation='auto'`` always picks the
-jnp/XLA path: on-chip measurement (OPSBENCH.json, scripts/opsbench.py)
-showed XLA beating or outliving the scalar-loop kernels at every
-production shape.
+``implementation='pallas'``. ``implementation='auto'`` follows on-chip
+measurement (OPSBENCH.json, scripts/opsbench.py): resample2d and
+channelnorm pin to the jnp/XLA path (XLA beat or outlived the
+hand-written kernels at every production shape); correlation pins to the
+'mxu' formulation — the cost volume recast as per-displacement-row
+matmuls plus a strided band-gather, 2.1x the scan path at FlowNetC's
+full shape — with the scan path covering general kernel sizes.
 """
 
 from imaginaire_tpu.ops.resample2d import resample2d

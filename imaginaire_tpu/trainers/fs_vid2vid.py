@@ -27,10 +27,17 @@ class Trainer(Vid2VidTrainer):
 
     def _get_data_t(self, data, t, prev_labels, prev_images):
         data_t = super()._get_data_t(data, t, prev_labels, prev_images)
-        data_t["ref_images"] = data["ref_images"]
-        if "ref_labels" in data:
-            data_t["ref_labels"] = data["ref_labels"]
+        data_t.update(self._rollout_scan_constants(data))
         return data_t
+
+    def _rollout_scan_constants(self, data):
+        """The few-shot reference window is constant across the clip —
+        declared here so the rollout-scan tail threads it into every
+        frame's data_t (see Vid2VidTrainer._scan_eligible)."""
+        out = {"ref_images": data["ref_images"]}
+        if "ref_labels" in data:
+            out["ref_labels"] = data["ref_labels"]
+        return out
 
     def gen_forward(self, vars_G, vars_D, loss_params, data, rng,
                     training=True):

@@ -51,6 +51,16 @@ class Trainer(BaseTrainer):
                                                ds.sequence_length_max)
         self._jit_vid_dis = jax.jit(self._vid_dis_step_fn, donate_argnums=0)
         self._jit_vid_gen = jax.jit(self._vid_gen_step_fn, donate_argnums=0)
+        # Whole-rollout mode (SURVEY §7 hard-part #3): once the history
+        # ring buffers reach their steady-state shapes, the remaining
+        # frames run as ONE lax.scan program — per-frame D+G updates with
+        # (params, opt state, ring buffers) in carry — instead of 2
+        # host-dispatched programs per frame. Opt-in via
+        # trainer.rollout_scan; see gen_update/_rollout_scan_tail.
+        self.rollout_scan = bool(cfg_get(cfg.trainer, "rollout_scan",
+                                         False))
+        self._jit_rollout_tail = jax.jit(self._rollout_tail_fn,
+                                         donate_argnums=0)
 
     # ---------------------------------------------------------------- loss
 
@@ -439,18 +449,99 @@ class Trainer(BaseTrainer):
                                    past_fake[:, -t_span::t_step])
         return stacks
 
+    def _rollout_tail_fn(self, state, buffers, tail, constants):
+        """Steady-state rollout tail as ONE program: lax.scan over frames
+        with (trainer state, history ring buffers) in carry and the
+        per-frame D then G updates in the body (SURVEY §7 hard-part #3).
+
+        Replaces 2 host dispatches + host-side ring-buffer concats per
+        frame with a single XLA while-loop — the compiler pipelines the
+        buffer rolls into the step programs, and dispatch/tunnel latency
+        is paid once per clip instead of twice per frame. Only valid
+        once every buffer has its steady shape (see gen_update's
+        t_steady); the warm-up frames keep the per-frame programs, whose
+        shapes differ structurally (no prev / growing stacks).
+        """
+        prev_labels, prev_images, past_real, past_fake = buffers
+        use_past = self.num_temporal_scales > 0 and past_real is not None
+        tD = self.num_frames_D
+        max_prev = (tD ** max(self.num_temporal_scales - 1, 0)) * (tD - 1)
+
+        def body(carry, xs):
+            if use_past:
+                state, prev_labels, prev_images, past_real, past_fake = carry
+            else:
+                state, prev_labels, prev_images = carry
+            data_t = dict(constants, label=xs["label"], image=xs["image"],
+                          real_prev_image=xs["real_prev_image"],
+                          prev_labels=prev_labels, prev_images=prev_images)
+            data_t["past_stacks"] = (
+                self._past_stacks(past_real, past_fake) if use_past else {})
+            state, d_losses = self._vid_dis_step_fn(state, data_t)
+            state, g_losses, fake = self._vid_gen_step_fn(state, data_t)
+            prev_labels = concat_frames(prev_labels, xs["label"],
+                                        self.num_frames_G - 1)
+            prev_images = concat_frames(prev_images, fake,
+                                        self.num_frames_G - 1)
+            if use_past:
+                past_real = concat_frames(past_real, xs["image"], max_prev)
+                past_fake = concat_frames(past_fake, fake, max_prev)
+                carry = (state, prev_labels, prev_images, past_real,
+                         past_fake)
+            else:
+                carry = (state, prev_labels, prev_images)
+            return carry, (d_losses, g_losses)
+
+        xs = jax.tree_util.tree_map(lambda a: jnp.moveaxis(a, 1, 0), tail)
+        carry0 = ((state, prev_labels, prev_images, past_real, past_fake)
+                  if use_past else (state, prev_labels, prev_images))
+        carry, (d_hist, g_hist) = jax.lax.scan(body, carry0, xs)
+        return carry[0], d_hist, g_hist
+
+    def _rollout_scan_constants(self, data):
+        """Per-frame-constant keys the scan-tail body must thread into
+        each data_t. A subclass that overrides ``_get_data_t`` MUST also
+        override this to declare its extra keys (fs-vid2vid does) — the
+        scan body builds data_t itself and would otherwise silently drop
+        them; _scan_eligible enforces the pairing."""
+        return {}
+
+    def _scan_eligible(self, data, seq_len):
+        """The scan tail is semantics-preserving only when the per-frame
+        host hooks are the defaults (wc-vid2vid colors point clouds per
+        frame), any ``_get_data_t`` override has declared its constant
+        keys, and the clip is a real 5-D sequence."""
+        cls = type(self)
+        data_t_accounted = (
+            cls._get_data_t is Trainer._get_data_t
+            or cls._rollout_scan_constants
+            is not Trainer._rollout_scan_constants)
+        return (self.rollout_scan and seq_len > 1
+                and data["images"].ndim == 5
+                and data_t_accounted
+                and cls._frame_override is Trainer._frame_override
+                and cls._after_gen_frame is Trainer._after_gen_frame)
+
     def gen_update(self, data):
-        """Interleaved per-frame D/G rollout (ref: vid2vid.py:238-288)."""
+        """Interleaved per-frame D/G rollout (ref: vid2vid.py:238-288).
+
+        With trainer.rollout_scan, frames past the ring-buffer warm-up
+        run inside one lax.scan program (_rollout_tail_fn)."""
         data = numeric_only(data)
         seq_len = (data["images"].shape[1] if data["images"].ndim == 5
                    else 1)
         tD = self.num_frames_D
         max_prev = (tD ** max(self.num_temporal_scales - 1, 0)) * (tD - 1)
+        # first frame at which every history buffer has its final shape
+        t_steady = max(self.num_frames_G - 1,
+                       max_prev if self.num_temporal_scales > 0 else 0, 1)
+        use_scan = self._scan_eligible(data, seq_len) and seq_len > t_steady
+        head_len = t_steady if use_scan else seq_len
         prev_labels = prev_images = None
         past_real = past_fake = None
         t0 = time.time() if self.speed_benchmark else None
         d_hist, g_hist = [], []
-        for t in range(seq_len):
+        for t in range(head_len):
             data_t = self._get_data_t(data, t, prev_labels, prev_images)
             fake = self._frame_override(data_t)
             if fake is None:
@@ -476,16 +567,41 @@ class Trainer(BaseTrainer):
                                         self.num_frames_G - 1)
             prev_images = concat_frames(prev_images, fake,
                                         self.num_frames_G - 1)
+        tail_counts = 0
+        if use_scan:
+            # constants every frame of the tail shares (few-shot refs)
+            constants = self._rollout_scan_constants(data)
+            tail = {"label": data["label"][:, t_steady:],
+                    "image": data["images"][:, t_steady:],
+                    "real_prev_image": data["images"][:, t_steady - 1:-1]}
+            buffers = (prev_labels, prev_images, past_real, past_fake)
+            self.state, d_tail, g_tail = self._jit_rollout_tail(
+                self.state, buffers, tail, constants)
+            tail_counts = seq_len - t_steady
+            d_hist.append({k: jnp.sum(v) for k, v in d_tail.items()})
+            g_hist.append({k: jnp.sum(v) for k, v in g_tail.items()})
         if self.speed_benchmark:
             jax.block_until_ready(self.state["vars_G"]["params"])
             self._meter("time/gen_step").write(time.time() - t0)
 
-        def mean_losses(hist):
+        def mean_losses(hist, tail_n):
+            # the last entry may be a summed tail worth tail_n frames
             keys = set().union(*(h.keys() for h in hist))
-            return {k: sum(h[k] for h in hist if k in h)
-                    / sum(1 for h in hist if k in h) for k in keys}
+            out = {}
+            for k in keys:
+                total = 0.0
+                count = 0
+                for i, h in enumerate(hist):
+                    if k not in h:
+                        continue
+                    is_tail = tail_n and i == len(hist) - 1
+                    total = total + h[k]
+                    count += tail_n if is_tail else 1
+                out[k] = total / count
+            return out
 
-        d_losses, g_losses = mean_losses(d_hist), mean_losses(g_hist)
+        d_losses = mean_losses(d_hist, tail_counts)
+        g_losses = mean_losses(g_hist, tail_counts)
         self._log_losses("dis_update", d_losses)
         self._log_losses("gen_update", g_losses)
         return g_losses

@@ -105,12 +105,22 @@ def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None,
         # never observe pointer-before-commit. The thread handle is kept
         # so wait_for_pending_checkpoint can join it — otherwise a later
         # save's pointer could be overwritten by this older thread, or
-        # the write lost at process exit.
+        # the write lost at process exit. A pointer-write failure is
+        # stashed on the thread and re-raised at the join, never
+        # swallowed (a stale pointer would silently lose progress).
         import threading
 
-        _POINTER_THREAD = threading.Thread(
-            target=lambda: (ckpt.wait_until_finished(), _write_pointer()),
-            daemon=True)
+        def _commit_then_point():
+            ckpt.wait_until_finished()
+            try:
+                _write_pointer()
+            except BaseException as e:  # re-raised by the joiner
+                _commit_then_point.error = e
+
+        _commit_then_point.error = None
+        _POINTER_THREAD = threading.Thread(target=_commit_then_point,
+                                           daemon=True)
+        _POINTER_THREAD._pointer_fn = _commit_then_point
         _POINTER_THREAD.start()
     else:
         with ocp.PyTreeCheckpointer() as ckpt:
@@ -126,8 +136,14 @@ def wait_for_pending_checkpoint():
     if _ASYNC_CKPT is not None:
         _ASYNC_CKPT.wait_until_finished()
     if _POINTER_THREAD is not None:
-        _POINTER_THREAD.join()
+        thread = _POINTER_THREAD
         _POINTER_THREAD = None
+        thread.join()
+        err = getattr(thread._pointer_fn, "error", None)
+        if err is not None:
+            raise RuntimeError(
+                "checkpoint pointer write failed; latest_checkpoint.txt "
+                "is stale") from err
 
 
 def latest_checkpoint_path(logdir):

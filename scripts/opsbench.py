@@ -122,7 +122,7 @@ def bench_correlation(cases):
     for shape in ((1, 64, 128, 256), (1, 32, 64, 256)):
         x1 = jnp.asarray(rng.rand(*shape), jnp.float32)
         x2 = jnp.asarray(rng.rand(*shape), jnp.float32)
-        for impl in ("jnp", "pallas"):
+        for impl in ("jnp", "mxu", "pallas"):
             _run_case(cases, "correlation", impl, shape,
                       lambda a, b, i=impl: correlation(a, b, implementation=i),
                       x1, x2)

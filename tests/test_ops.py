@@ -97,7 +97,7 @@ def test_channelnorm(rng, impl, p):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("impl", ["jnp", "pallas_interpret"])
+@pytest.mark.parametrize("impl", ["jnp", "mxu", "pallas_interpret"])
 def test_correlation(rng, impl):
     x1 = rng.randn(2, 6, 7, 4).astype(np.float32)
     x2 = rng.randn(2, 6, 7, 4).astype(np.float32)
@@ -112,12 +112,43 @@ def test_correlation(rng, impl):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
-def test_correlation_stride2(rng):
+@pytest.mark.parametrize("impl", ["jnp", "mxu"])
+def test_correlation_stride2(rng, impl):
     x1 = rng.randn(1, 5, 5, 3).astype(np.float32)
     x2 = rng.randn(1, 5, 5, 3).astype(np.float32)
     got = np.asarray(
         correlation(jnp.asarray(x1), jnp.asarray(x2), pad_size=4, max_displacement=4, stride2=2,
-                    implementation="jnp")
+                    implementation=impl)
     )
     want = np_correlation(x1, x2, pad=4, md=4, s2=2)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_mxu_matches_jnp_flownetc_shape(rng):
+    """The MXU matmul+band-gather formulation must be bit-comparable to
+    the scan path at the FlowNetC operating configuration."""
+    x1 = rng.randn(1, 8, 12, 16).astype(np.float32)
+    x2 = rng.randn(1, 8, 12, 16).astype(np.float32)
+    kw = dict(pad_size=20, max_displacement=20, stride2=2)
+    a = np.asarray(correlation(jnp.asarray(x1), jnp.asarray(x2),
+                               implementation="jnp", **kw))
+    b = np.asarray(correlation(jnp.asarray(x1), jnp.asarray(x2),
+                               implementation="mxu", **kw))
+    assert a.shape == b.shape == (1, 8, 12, 441)
+    np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_auto_guard_indivisible_displacement(rng):
+    """auto must NOT pick mxu when max_displacement % stride2 != 0 (the
+    band grid would drop the +md displacement); explicit mxu refuses."""
+    x1 = rng.randn(1, 5, 5, 3).astype(np.float32)
+    x2 = rng.randn(1, 5, 5, 3).astype(np.float32)
+    got = np.asarray(correlation(jnp.asarray(x1), jnp.asarray(x2),
+                                 pad_size=5, max_displacement=5, stride2=2,
+                                 implementation="auto"))
+    want = np_correlation(x1, x2, pad=5, md=5, s2=2)
+    assert got.shape == want.shape  # scan-grid channel count (6x6)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    with pytest.raises(NotImplementedError, match="divisible"):
+        correlation(jnp.asarray(x1), jnp.asarray(x2), pad_size=5,
+                    max_displacement=5, stride2=2, implementation="mxu")

@@ -324,3 +324,37 @@ class TestMultiDeviceVid2Vid:
             assert any(k.startswith("GAN_T") for k in g), g.keys()
         finally:
             set_mesh(old)
+
+
+@pytest.mark.slow
+class TestRolloutScan:
+    """trainer.rollout_scan: the steady-state tail of the interleaved
+    rollout runs as one lax.scan program (trainers/vid2vid.py::
+    _rollout_tail_fn, SURVEY §7 hard-part #3). Same data + same seeds
+    must give the same training result as the per-frame path."""
+
+    def _run(self, rng_seed, scan, tmp_path, t=4):
+        rng = np.random.RandomState(rng_seed)
+        cfg = Config(CFG)
+        cfg.logdir = str(tmp_path / ("scan" if scan else "loop"))
+        cfg.trainer.rollout_scan = scan
+        # shrink the perceptual graph: equivalence, not capacity
+        cfg.trainer.perceptual_loss.layers = ["relu_1_1", "relu_2_1"]
+        cfg.trainer.perceptual_loss.weights = [0.5, 1.0]
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        data = video_batch(np.random.RandomState(7), t=t)
+        trainer.init_state(jax.random.PRNGKey(0), data)
+        losses = trainer.gen_update(data)
+        leaf = jax.tree_util.tree_leaves(
+            trainer.state["vars_G"]["params"])[0]
+        return ({k: float(jax.device_get(v)) for k, v in losses.items()},
+                np.asarray(jax.device_get(leaf)))
+
+    def test_scan_matches_per_frame_path(self, tmp_path):
+        losses_a, leaf_a = self._run(0, False, tmp_path)
+        losses_b, leaf_b = self._run(0, True, tmp_path)
+        assert set(losses_a) == set(losses_b)
+        for k in losses_a:
+            np.testing.assert_allclose(losses_b[k], losses_a[k],
+                                       rtol=2e-3, atol=2e-4, err_msg=k)
+        np.testing.assert_allclose(leaf_b, leaf_a, rtol=2e-3, atol=2e-4)
