@@ -518,6 +518,8 @@ class Trainer(BaseTrainer):
             is not Trainer._rollout_scan_constants)
         return (self.rollout_scan and seq_len > 1
                 and data["images"].ndim == 5
+                and data["label"].ndim == 5  # static 4-D labels use the
+                # per-frame path (the tail slices labels along time)
                 and data_t_accounted
                 and cls._frame_override is Trainer._frame_override
                 and cls._after_gen_frame is Trainer._after_gen_frame)

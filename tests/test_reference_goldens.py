@@ -16,6 +16,10 @@ and pin forward / loss parity on identical inputs:
   - Full SPADE Discriminator (FPSE + patch) forward and hinge-GAN /
     feature-matching / KL loss values (ref: imaginaire/discriminators/
     spade.py:73-117, losses/gan.py, feature_matching.py, kl.py)
+  - Full pix2pixHD GlobalGenerator (ref: generators/pix2pixHD.py:240-275)
+  - Full FUNIT translator: content/style encoders + MLP + AdaIN decoder
+    with up-res blocks (ref: generators/funit.py:69-398)
+  - Full MUNIT autoencoder reconstruction (ref: generators/munit.py:159-421)
 
 Import shims (albumentations; torch.Tensor.cuda as a CPU no-op for the
 generator's ``self.xy.cuda()``) only unblock imports — they change no math.
@@ -733,3 +737,281 @@ class TestSpadeDiscriminatorGolden:
         ]
         for got_v, want_v in pairs:
             np.testing.assert_allclose(got_v, want_v, rtol=2e-3, atol=2e-4)
+
+
+# ----------------------------------------------------- pix2pixHD tier
+
+
+class TestPix2pixHDGlobalGolden:
+    """Full pix2pixHD GlobalGenerator forward against the reference's
+    Sequential (ref: imaginaire/generators/pix2pixHD.py:240-275),
+    weight-converted index-by-index."""
+
+    def _build_ref(self, num_labels, nf, nd, nr):
+        import functools
+        import types as _t
+
+        from imaginaire.generators import pix2pixHD as ref_p2p
+        from imaginaire.layers import Conv2dBlock as TConv
+        from imaginaire.layers import Res2dBlock as TRes
+
+        base_conv_block = functools.partial(
+            TConv, padding_mode="reflect", weight_norm_type="",
+            activation_norm_type="instance", activation_norm_params=None,
+            nonlinearity="relu")
+        base_res_block = functools.partial(
+            TRes, padding_mode="reflect", weight_norm_type="",
+            activation_norm_type="instance", activation_norm_params=None,
+            nonlinearity="relu", order="CNACN")
+        gen_cfg = _t.SimpleNamespace(num_filters=nf, num_downsamples=nd,
+                                     num_res_blocks=nr)
+        data_cfg = _t.SimpleNamespace(
+            type="imaginaire.datasets.paired_images",
+            input_types=[{"images": _t.SimpleNamespace(num_channels=3)},
+                         {"seg_maps": _t.SimpleNamespace(
+                             num_channels=num_labels)}],
+            input_image=["images"], input_labels=["seg_maps"])
+        return ref_p2p.GlobalGenerator(gen_cfg, data_cfg, num_labels,
+                                       "reflect", base_conv_block,
+                                       base_res_block)
+
+    def _convert(self, tglobal, nd, nr):
+        params, bstats = {}, {}
+        seq = list(tglobal.model)
+        k = 0
+
+        def put_conv(name, mod):
+            p, s, b = convert_conv_block(mod)
+            params[name] = p
+            if b:
+                bstats[name] = b
+
+        put_conv("conv_in", seq[k]); k += 1
+        for i in range(nd):
+            put_conv(f"down_{i}", seq[k]); k += 1
+        for i in range(nr):
+            p, s, b = convert_res_block(seq[k])
+            params[f"res_{i}"] = p
+            k += 1
+        for i in reversed(range(nd)):
+            k += 1  # NearestUpsample module — no params
+            put_conv(f"up_{i}", seq[k]); k += 1
+        put_conv("conv_out", seq[k])
+        return params, bstats
+
+    def test_global_generator_matches_reference(self, ref):
+        from imaginaire_tpu.models.generators.pix2pixHD import GlobalGenerator
+
+        num_labels, nf, nd, nr = 5, 4, 2, 3
+        torch.manual_seed(10)
+        tg = self._build_ref(num_labels, nf, nd, nr)
+        tg.train()
+        jg = GlobalGenerator(num_filters=nf, num_downsamples=nd,
+                             num_res_blocks=nr, num_img_channels=3,
+                             padding_mode="reflect", weight_norm_type="",
+                             activation_norm_type="instance",
+                             output_img=True)
+        rng = np.random.RandomState(11)
+        seg = _block_seg(rng, 2, 64, 64, num_labels)
+        variables = jg.init(jax.random.PRNGKey(0), seg, training=True)
+        p, b = self._convert(tg, nd, nr)
+        variables = _merge_variables(variables, p, {}, b)
+        want = to_nhwc(tg(nchw(seg)))
+        got = jg.apply(variables, seg, training=True)
+        assert np.asarray(got).shape == want.shape
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=2e-3, atol=2e-4)
+
+
+# --------------------------------------------------------- FUNIT tier
+
+
+class TestFunitGeneratorGolden:
+    """Full FUNIT translator (content/style encoders + MLP + AdaIN
+    decoder with up-res blocks) against the reference
+    (ref: imaginaire/generators/funit.py:69-398), weight-converted."""
+
+    NF, NF_MLP, STYLE, NRB, NMLP, NDS, NDC = 8, 16, 8, 2, 3, 3, 2
+
+    def _build_ref(self):
+        import types as _t
+
+        from imaginaire.generators import funit as ref_funit
+
+        gen_cfg = _t.SimpleNamespace(
+            num_filters=self.NF, num_filters_mlp=self.NF_MLP,
+            style_dims=self.STYLE, num_res_blocks=self.NRB,
+            num_mlp_blocks=self.NMLP, num_downsamples_style=self.NDS,
+            num_downsamples_content=self.NDC, weight_norm_type="")
+        return ref_funit.Generator(gen_cfg, None)
+
+    def _convert(self, tgen):
+        tr = tgen.generator
+        params = {}
+
+        # style encoder: Sequential [conv7, down x2 (doubling),
+        # down x(nds-2), AdaptiveAvgPool2d, 1x1 Conv2d]
+        se = {}
+        seq = list(tr.style_encoder.model)
+        se["conv_in"], _, _ = convert_conv_block(seq[0])
+        for i in range(self.NDS):
+            se[f"down_{i}"], _, _ = convert_conv_block(seq[1 + i])
+        final = seq[-1]  # plain nn.Conv2d(nf, style, 1) on the pooled vec
+        se["fc_out"] = {"kernel": t2j(final.weight)[:, :, 0, 0].T,
+                        "bias": t2j(final.bias)}
+        params["style_encoder"] = se
+
+        # content encoder: Sequential [conv7, down x ndc, res x nrb]
+        ce = {}
+        seq = list(tr.content_encoder.model)
+        ce["conv_in"], _, _ = convert_conv_block(seq[0])
+        for i in range(self.NDC):
+            ce[f"down_{i}"], _, _ = convert_conv_block(seq[1 + i])
+        for i in range(self.NRB):
+            p, _, _ = convert_res_block(seq[1 + self.NDC + i])
+            ce[f"res_{i}"] = p
+        params["content_encoder"] = ce
+
+        # decoder: ModuleList [res, res, upres x ndc, conv7-tanh]
+        de = {}
+        blocks = list(tr.decoder.decoder)
+        for i in range(2):
+            p, _, _ = convert_res_block(blocks[i])
+            de[f"res_{i}"] = p
+        for i in range(self.NDC):
+            p, _, _ = convert_res_block(blocks[2 + i])
+            de[f"up_{i}"] = p
+        de["conv_out"], _, _ = convert_conv_block(blocks[-1])
+        params["decoder"] = de
+
+        # MLP: Sequential of LinearBlocks [in, hidden x (nmlp-3), out]
+        ml = {}
+        seq = list(tr.mlp.model)
+        p, _, _ = convert_conv_block(seq[0])
+        ml["fc_in"] = p
+        for i in range(len(seq) - 2):
+            p, _, _ = convert_conv_block(seq[1 + i])
+            ml[f"fc_{i}"] = p
+        p, _, _ = convert_conv_block(seq[-1])
+        ml["fc_out"] = p
+        params["mlp"] = ml
+        return {"generator": params}
+
+    def test_translator_matches_reference(self, ref):
+        from imaginaire_tpu.models.generators.funit import Generator
+
+        torch.manual_seed(12)
+        tgen = self._build_ref()
+        tgen.train()
+        jgen = Generator({
+            "num_filters": self.NF, "num_filters_mlp": self.NF_MLP,
+            "style_dims": self.STYLE, "num_res_blocks": self.NRB,
+            "num_mlp_blocks": self.NMLP,
+            "num_downsamples_style": self.NDS,
+            "num_downsamples_content": self.NDC,
+            "weight_norm_type": ""})
+        rng = np.random.RandomState(13)
+        data_j = {
+            "images_content": rng.randn(2, 64, 64, 3).astype(np.float32) * .5,
+            "images_style": rng.randn(2, 64, 64, 3).astype(np.float32) * .5,
+        }
+        variables = jgen.init(jax.random.PRNGKey(0), data_j, training=True)
+        variables = _merge_variables(variables, self._convert(tgen), {})
+        data_t = {"images_content": nchw(data_j["images_content"]),
+                  "images_style": nchw(data_j["images_style"])}
+        want = tgen(data_t)
+        got = jgen.apply(variables, data_j, training=True)
+        for key in ("images_trans", "images_recon"):
+            np.testing.assert_allclose(np.asarray(got[key]),
+                                       to_nhwc(want[key]),
+                                       rtol=2e-3, atol=2e-4, err_msg=key)
+
+
+# --------------------------------------------------------- MUNIT tier
+
+
+class TestMunitAutoEncoderGolden:
+    """Full MUNIT autoencoder (style/content encoders + MLP + AdaIN
+    decoder) reconstruction against the reference
+    (ref: imaginaire/generators/munit.py:159-421), weight-converted."""
+
+    NF, MAXF, NF_MLP, LATENT, NRB, NMLP, NDS, NDC = 8, 32, 16, 8, 2, 2, 3, 2
+
+    def _build_ref(self):
+        from imaginaire.generators import munit as ref_munit
+
+        return ref_munit.AutoEncoder(
+            num_filters=self.NF, max_num_filters=self.MAXF,
+            num_filters_mlp=self.NF_MLP, latent_dim=self.LATENT,
+            num_res_blocks=self.NRB, num_mlp_blocks=self.NMLP,
+            num_downsamples_style=self.NDS,
+            num_downsamples_content=self.NDC)
+
+    def _convert(self, tae):
+        params = {}
+        se = {}
+        seq = list(tae.style_encoder.model)
+        se["conv_in"], _, _ = convert_conv_block(seq[0])
+        for i in range(self.NDS):
+            se[f"down_{i}"], _, _ = convert_conv_block(seq[1 + i])
+        final = seq[-1]
+        se["fc_out"] = {"kernel": t2j(final.weight)[:, :, 0, 0].T,
+                        "bias": t2j(final.bias)}
+        params["style_encoder"] = se
+
+        ce, b_all = {}, {}
+        seq = list(tae.content_encoder.model)
+        ce["conv_in"], _, _ = convert_conv_block(seq[0])
+        for i in range(self.NDC):
+            ce[f"down_{i}"], _, _ = convert_conv_block(seq[1 + i])
+        for i in range(self.NRB):
+            p, _, _ = convert_res_block(seq[1 + self.NDC + i])
+            ce[f"res_{i}"] = p
+        params["content_encoder"] = ce
+
+        de = {}
+        blocks = list(tae.decoder.decoder)
+        k = 0
+        for i in range(self.NRB):
+            p, _, _ = convert_res_block(blocks[k])
+            de[f"res_{i}"] = p
+            k += 1
+        for i in range(self.NDC):
+            k += 1  # NearestUpsample
+            de[f"up_{i}"], _, _ = convert_conv_block(blocks[k])
+            k += 1
+        de["conv_out"], _, _ = convert_conv_block(blocks[k])
+        params["decoder"] = de
+
+        ml = {}
+        seq = list(tae.mlp.model)
+        p, _, _ = convert_conv_block(seq[0])
+        ml["fc_in"] = p
+        for i in range(len(seq) - 2):
+            p, _, _ = convert_conv_block(seq[1 + i])
+            ml[f"fc_{i}"] = p
+        p, _, _ = convert_conv_block(seq[-1])
+        ml["fc_out"] = p
+        params["mlp"] = ml
+        return params
+
+    def test_autoencoder_reconstruction_matches(self, ref):
+        from imaginaire_tpu.models.generators.munit import AutoEncoder
+
+        torch.manual_seed(14)
+        tae = self._build_ref()
+        tae.train()
+        jae = AutoEncoder({
+            "num_filters": self.NF, "max_num_filters": self.MAXF,
+            "num_filters_mlp": self.NF_MLP, "latent_dim": self.LATENT,
+            "num_res_blocks": self.NRB, "num_mlp_blocks": self.NMLP,
+            "num_downsamples_style": self.NDS,
+            "num_downsamples_content": self.NDC})
+        rng = np.random.RandomState(15)
+        x = rng.randn(2, 64, 64, 3).astype(np.float32) * 0.5
+        variables = jae.init(jax.random.PRNGKey(0), x, training=True)
+        variables = _merge_variables(variables, self._convert(tae), {})
+        want = to_nhwc(tae(nchw(x)))
+        got = jae.apply(variables, x, training=True)
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=2e-3, atol=2e-4)

@@ -333,8 +333,7 @@ class TestRolloutScan:
     _rollout_tail_fn, SURVEY §7 hard-part #3). Same data + same seeds
     must give the same training result as the per-frame path."""
 
-    def _run(self, rng_seed, scan, tmp_path, t=4):
-        rng = np.random.RandomState(rng_seed)
+    def _run(self, scan, tmp_path, t=4):
         cfg = Config(CFG)
         cfg.logdir = str(tmp_path / ("scan" if scan else "loop"))
         cfg.trainer.rollout_scan = scan
@@ -351,8 +350,8 @@ class TestRolloutScan:
                 np.asarray(jax.device_get(leaf)))
 
     def test_scan_matches_per_frame_path(self, tmp_path):
-        losses_a, leaf_a = self._run(0, False, tmp_path)
-        losses_b, leaf_b = self._run(0, True, tmp_path)
+        losses_a, leaf_a = self._run(False, tmp_path)
+        losses_b, leaf_b = self._run(True, tmp_path)
         assert set(losses_a) == set(losses_b)
         for k in losses_a:
             np.testing.assert_allclose(losses_b[k], losses_a[k],
