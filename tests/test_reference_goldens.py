@@ -20,6 +20,15 @@ and pin forward / loss parity on identical inputs:
   - Full FUNIT translator: content/style encoders + MLP + AdaIN decoder
     with up-res blocks (ref: generators/funit.py:69-398)
   - Full MUNIT autoencoder reconstruction (ref: generators/munit.py:159-421)
+  - Full UNIT autoencoder reconstruction (ref: generators/unit.py:91-300)
+  - Full COCO-FUNIT translator incl. universal style bias + content-gated
+    style fusion (ref: generators/coco_funit.py:71-194)
+
+The vid2vid / fs-vid2vid / wc-vid2vid reference generators import the
+CUDA third_party ops at module import time and cannot be loaded on CPU
+torch; those families are covered by the hand-built FlowNet2/resample
+goldens (test_network_goldens.py, test_flownet2.py) plus the learning
+tier instead.
 
 Import shims (albumentations; torch.Tensor.cuda as a CPU no-op for the
 generator's ``self.xy.cuda()``) only unblock imports — they change no math.
@@ -823,6 +832,68 @@ class TestPix2pixHDGlobalGolden:
                                    rtol=2e-3, atol=2e-4)
 
 
+# Shared sequential-walk converters for the UNIT-family encoders/decoders
+# (style enc: [conv7, downs..., AdaptiveAvgPool2d, 1x1 Conv2d]; content
+# enc: [conv7, downs..., res...]; decoder ModuleList:
+# [res..., (NearestUpsample, conv)... , conv_out]; MLP: LinearBlocks).
+
+
+def _convert_style_encoder_seq(seq, n_down):
+    se = {}
+    se["conv_in"], _, _ = convert_conv_block(seq[0])
+    for i in range(n_down):
+        se[f"down_{i}"], _, _ = convert_conv_block(seq[1 + i])
+    final = seq[-1]  # plain nn.Conv2d(nf, style, 1) on the pooled vec
+    se["fc_out"] = {"kernel": t2j(final.weight)[:, :, 0, 0].T,
+                    "bias": t2j(final.bias)}
+    return se
+
+
+def _convert_content_encoder_seq(seq, n_down, n_res):
+    ce = {}
+    ce["conv_in"], _, _ = convert_conv_block(seq[0])
+    for i in range(n_down):
+        ce[f"down_{i}"], _, _ = convert_conv_block(seq[1 + i])
+    for i in range(n_res):
+        p, _, _ = convert_res_block(seq[1 + n_down + i])
+        ce[f"res_{i}"] = p
+    return ce
+
+
+def _convert_decoder_blocks(blocks, n_res, n_ups, upres):
+    """``upres=True``: upsampling via UpRes2dBlocks (FUNIT); otherwise
+    (NearestUpsample, Conv2dBlock) pairs (MUNIT/UNIT)."""
+    de = {}
+    k = 0
+    for i in range(n_res):
+        p, _, _ = convert_res_block(blocks[k])
+        de[f"res_{i}"] = p
+        k += 1
+    for i in range(n_ups):
+        if upres:
+            p, _, _ = convert_res_block(blocks[k])
+            de[f"up_{i}"] = p
+            k += 1
+        else:
+            k += 1  # NearestUpsample — no params
+            de[f"up_{i}"], _, _ = convert_conv_block(blocks[k])
+            k += 1
+    de["conv_out"], _, _ = convert_conv_block(blocks[k])
+    return de
+
+
+def _convert_mlp_seq(seq):
+    ml = {}
+    p, _, _ = convert_conv_block(seq[0])
+    ml["fc_in"] = p
+    for i in range(len(seq) - 2):
+        p, _, _ = convert_conv_block(seq[1 + i])
+        ml[f"fc_{i}"] = p
+    p, _, _ = convert_conv_block(seq[-1])
+    ml["fc_out"] = p
+    return ml
+
+
 # --------------------------------------------------------- FUNIT tier
 
 
@@ -847,54 +918,15 @@ class TestFunitGeneratorGolden:
 
     def _convert(self, tgen):
         tr = tgen.generator
-        params = {}
-
-        # style encoder: Sequential [conv7, down x2 (doubling),
-        # down x(nds-2), AdaptiveAvgPool2d, 1x1 Conv2d]
-        se = {}
-        seq = list(tr.style_encoder.model)
-        se["conv_in"], _, _ = convert_conv_block(seq[0])
-        for i in range(self.NDS):
-            se[f"down_{i}"], _, _ = convert_conv_block(seq[1 + i])
-        final = seq[-1]  # plain nn.Conv2d(nf, style, 1) on the pooled vec
-        se["fc_out"] = {"kernel": t2j(final.weight)[:, :, 0, 0].T,
-                        "bias": t2j(final.bias)}
-        params["style_encoder"] = se
-
-        # content encoder: Sequential [conv7, down x ndc, res x nrb]
-        ce = {}
-        seq = list(tr.content_encoder.model)
-        ce["conv_in"], _, _ = convert_conv_block(seq[0])
-        for i in range(self.NDC):
-            ce[f"down_{i}"], _, _ = convert_conv_block(seq[1 + i])
-        for i in range(self.NRB):
-            p, _, _ = convert_res_block(seq[1 + self.NDC + i])
-            ce[f"res_{i}"] = p
-        params["content_encoder"] = ce
-
-        # decoder: ModuleList [res, res, upres x ndc, conv7-tanh]
-        de = {}
-        blocks = list(tr.decoder.decoder)
-        for i in range(2):
-            p, _, _ = convert_res_block(blocks[i])
-            de[f"res_{i}"] = p
-        for i in range(self.NDC):
-            p, _, _ = convert_res_block(blocks[2 + i])
-            de[f"up_{i}"] = p
-        de["conv_out"], _, _ = convert_conv_block(blocks[-1])
-        params["decoder"] = de
-
-        # MLP: Sequential of LinearBlocks [in, hidden x (nmlp-3), out]
-        ml = {}
-        seq = list(tr.mlp.model)
-        p, _, _ = convert_conv_block(seq[0])
-        ml["fc_in"] = p
-        for i in range(len(seq) - 2):
-            p, _, _ = convert_conv_block(seq[1 + i])
-            ml[f"fc_{i}"] = p
-        p, _, _ = convert_conv_block(seq[-1])
-        ml["fc_out"] = p
-        params["mlp"] = ml
+        params = {
+            "style_encoder": _convert_style_encoder_seq(
+                list(tr.style_encoder.model), self.NDS),
+            "content_encoder": _convert_content_encoder_seq(
+                list(tr.content_encoder.model), self.NDC, self.NRB),
+            "decoder": _convert_decoder_blocks(
+                list(tr.decoder.decoder), 2, self.NDC, upres=True),
+            "mlp": _convert_mlp_seq(list(tr.mlp.model)),
+        }
         return {"generator": params}
 
     def test_translator_matches_reference(self, ref):
@@ -948,52 +980,15 @@ class TestMunitAutoEncoderGolden:
             num_downsamples_content=self.NDC)
 
     def _convert(self, tae):
-        params = {}
-        se = {}
-        seq = list(tae.style_encoder.model)
-        se["conv_in"], _, _ = convert_conv_block(seq[0])
-        for i in range(self.NDS):
-            se[f"down_{i}"], _, _ = convert_conv_block(seq[1 + i])
-        final = seq[-1]
-        se["fc_out"] = {"kernel": t2j(final.weight)[:, :, 0, 0].T,
-                        "bias": t2j(final.bias)}
-        params["style_encoder"] = se
-
-        ce, b_all = {}, {}
-        seq = list(tae.content_encoder.model)
-        ce["conv_in"], _, _ = convert_conv_block(seq[0])
-        for i in range(self.NDC):
-            ce[f"down_{i}"], _, _ = convert_conv_block(seq[1 + i])
-        for i in range(self.NRB):
-            p, _, _ = convert_res_block(seq[1 + self.NDC + i])
-            ce[f"res_{i}"] = p
-        params["content_encoder"] = ce
-
-        de = {}
-        blocks = list(tae.decoder.decoder)
-        k = 0
-        for i in range(self.NRB):
-            p, _, _ = convert_res_block(blocks[k])
-            de[f"res_{i}"] = p
-            k += 1
-        for i in range(self.NDC):
-            k += 1  # NearestUpsample
-            de[f"up_{i}"], _, _ = convert_conv_block(blocks[k])
-            k += 1
-        de["conv_out"], _, _ = convert_conv_block(blocks[k])
-        params["decoder"] = de
-
-        ml = {}
-        seq = list(tae.mlp.model)
-        p, _, _ = convert_conv_block(seq[0])
-        ml["fc_in"] = p
-        for i in range(len(seq) - 2):
-            p, _, _ = convert_conv_block(seq[1 + i])
-            ml[f"fc_{i}"] = p
-        p, _, _ = convert_conv_block(seq[-1])
-        ml["fc_out"] = p
-        params["mlp"] = ml
-        return params
+        return {
+            "style_encoder": _convert_style_encoder_seq(
+                list(tae.style_encoder.model), self.NDS),
+            "content_encoder": _convert_content_encoder_seq(
+                list(tae.content_encoder.model), self.NDC, self.NRB),
+            "decoder": _convert_decoder_blocks(
+                list(tae.decoder.decoder), self.NRB, self.NDC, upres=False),
+            "mlp": _convert_mlp_seq(list(tae.mlp.model)),
+        }
 
     def test_autoencoder_reconstruction_matches(self, ref):
         from imaginaire_tpu.models.generators.munit import AutoEncoder
@@ -1015,3 +1010,109 @@ class TestMunitAutoEncoderGolden:
         got = jae.apply(variables, x, training=True)
         np.testing.assert_allclose(np.asarray(got), want,
                                    rtol=2e-3, atol=2e-4)
+
+
+# ---------------------------------------------------------- UNIT tier
+
+
+class TestUnitAutoEncoderGolden:
+    """Full UNIT autoencoder reconstruction against the reference
+    (ref: imaginaire/generators/unit.py:91-300), weight-converted."""
+
+    NF, MAXF, NRB, NDC = 8, 32, 2, 2
+
+    def _build_ref(self):
+        from imaginaire.generators import unit as ref_unit
+
+        return ref_unit.AutoEncoder(
+            num_filters=self.NF, max_num_filters=self.MAXF,
+            num_res_blocks=self.NRB, num_downsamples_content=self.NDC)
+
+    def _convert(self, tae):
+        return {
+            "content_encoder": _convert_content_encoder_seq(
+                list(tae.content_encoder.model), self.NDC, self.NRB),
+            "decoder": _convert_decoder_blocks(
+                list(tae.decoder.decoder), self.NRB, self.NDC, upres=False),
+        }
+
+    def test_autoencoder_reconstruction_matches(self, ref):
+        from imaginaire_tpu.models.generators.unit import AutoEncoder
+
+        torch.manual_seed(16)
+        tae = self._build_ref()
+        tae.train()
+        jae = AutoEncoder({
+            "num_filters": self.NF, "max_num_filters": self.MAXF,
+            "num_res_blocks": self.NRB,
+            "num_downsamples_content": self.NDC})
+        rng = np.random.RandomState(17)
+        x = rng.randn(2, 64, 64, 3).astype(np.float32) * 0.5
+        variables = jae.init(jax.random.PRNGKey(0), x, training=True)
+        variables = _merge_variables(variables, self._convert(tae), {})
+        want = to_nhwc(tae(nchw(x)))
+        got = jae.apply(variables, x, training=True)
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=2e-3, atol=2e-4)
+
+
+# ---------------------------------------------------- COCO-FUNIT tier
+
+
+class TestCocoFunitGeneratorGolden(TestFunitGeneratorGolden):
+    """COCO-FUNIT: FUNIT plus the universal style bias and the
+    content-gated style fusion MLPs
+    (ref: imaginaire/generators/coco_funit.py:71-194)."""
+
+    USB = 16
+
+    def _build_ref(self):
+        import types as _t
+
+        from imaginaire.generators import coco_funit as ref_coco
+
+        gen_cfg = _t.SimpleNamespace(
+            num_filters=self.NF, num_filters_mlp=self.NF_MLP,
+            style_dims=self.STYLE, usb_dims=self.USB,
+            num_res_blocks=self.NRB, num_mlp_blocks=self.NMLP,
+            num_downsamples_style=self.NDS,
+            num_downsamples_content=self.NDC, weight_norm_type="")
+        return ref_coco.Generator(gen_cfg, None)
+
+    def _convert(self, tgen):
+        out = super()._convert(tgen)
+        tr = tgen.generator
+        params = out["generator"]
+        params["usb"] = t2j(tr.usb)
+        for name in ("mlp_content", "mlp_style"):
+            params[name] = _convert_mlp_seq(list(getattr(tr, name).model))
+        return out
+
+    def test_translator_matches_reference(self, ref):
+        from imaginaire_tpu.models.generators.coco_funit import Generator
+
+        torch.manual_seed(18)
+        tgen = self._build_ref()
+        tgen.train()
+        jgen = Generator({
+            "num_filters": self.NF, "num_filters_mlp": self.NF_MLP,
+            "style_dims": self.STYLE, "usb_dims": self.USB,
+            "num_res_blocks": self.NRB, "num_mlp_blocks": self.NMLP,
+            "num_downsamples_style": self.NDS,
+            "num_downsamples_content": self.NDC,
+            "weight_norm_type": ""})
+        rng = np.random.RandomState(19)
+        data_j = {
+            "images_content": rng.randn(2, 64, 64, 3).astype(np.float32) * .5,
+            "images_style": rng.randn(2, 64, 64, 3).astype(np.float32) * .5,
+        }
+        variables = jgen.init(jax.random.PRNGKey(0), data_j, training=True)
+        variables = _merge_variables(variables, self._convert(tgen), {})
+        data_t = {"images_content": nchw(data_j["images_content"]),
+                  "images_style": nchw(data_j["images_style"])}
+        want = tgen(data_t)
+        got = jgen.apply(variables, data_j, training=True)
+        for key in ("images_trans", "images_recon"):
+            np.testing.assert_allclose(np.asarray(got[key]),
+                                       to_nhwc(want[key]),
+                                       rtol=2e-3, atol=2e-4, err_msg=key)
