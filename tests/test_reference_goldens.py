@@ -1116,3 +1116,80 @@ class TestCocoFunitGeneratorGolden(TestFunitGeneratorGolden):
             np.testing.assert_allclose(np.asarray(got[key]),
                                        to_nhwc(want[key]),
                                        rtol=2e-3, atol=2e-4, err_msg=key)
+
+
+class TestFunitDiscriminatorGolden:
+    """FUNIT projection discriminator (residual trunk + class-projection
+    logits) against the reference
+    (ref: imaginaire/discriminators/funit.py:52-119), weight-converted."""
+
+    NF, MAXF, NL, NCLS = 8, 32, 3, 5
+
+    def _build_ref(self):
+        import types as _t
+
+        from imaginaire.discriminators import funit as ref_dis
+
+        dis_cfg = _t.SimpleNamespace(
+            num_filters=self.NF, max_num_filters=self.MAXF,
+            num_layers=self.NL, num_classes=self.NCLS,
+            weight_norm_type="")
+        return ref_dis.Discriminator(dis_cfg, None)
+
+    def _convert(self, tdis):
+        m = tdis.model
+        params = {}
+        seq = list(m.model)
+        k = 0
+        params["conv_in"], _, _ = convert_conv_block(seq[k]); k += 1
+        for i in range(self.NL):
+            p, _, _ = convert_res_block(seq[k]); k += 1
+            params[f"res_{i}_0"] = p
+            p, _, _ = convert_res_block(seq[k]); k += 1
+            params[f"res_{i}_1"] = p
+            if i != self.NL - 1:
+                k += 2  # ReflectionPad2d + AvgPool2d — no params
+        params["classifier"], _, _ = convert_conv_block(m.classifier)
+        params["embedder"] = {"embedding": t2j(m.embedder.weight)}
+        return {"model": params}
+
+    def test_forward_matches_reference(self, ref):
+        from imaginaire_tpu.models.discriminators.funit import Discriminator
+
+        torch.manual_seed(20)
+        tdis = self._build_ref()
+        tdis.train()
+        jdis = Discriminator({
+            "num_filters": self.NF, "max_num_filters": self.MAXF,
+            "num_layers": self.NL, "num_classes": self.NCLS,
+            "weight_norm_type": ""})
+        rng = np.random.RandomState(21)
+        data_j = {
+            "images_style": rng.randn(2, 32, 32, 3).astype(np.float32) * .5,
+            "labels_style": np.array([1, 3], np.int32),
+            "labels_content": np.array([0, 4], np.int32),
+        }
+        g_out_j = {
+            "images_trans": rng.randn(2, 32, 32, 3).astype(np.float32) * .5,
+            "images_recon": rng.randn(2, 32, 32, 3).astype(np.float32) * .5,
+        }
+        variables = jdis.init(jax.random.PRNGKey(0), data_j, g_out_j,
+                              training=True)
+        variables = _merge_variables(variables, self._convert(tdis), {})
+        data_t = {"images_style": nchw(data_j["images_style"]),
+                  "labels_style": torch.from_numpy(
+                      data_j["labels_style"].astype(np.int64)),
+                  "labels_content": torch.from_numpy(
+                      data_j["labels_content"].astype(np.int64))}
+        g_out_t = {"images_trans": nchw(g_out_j["images_trans"]),
+                   "images_recon": nchw(g_out_j["images_recon"])}
+        want = tdis(data_t, g_out_t)
+        got = jdis.apply(variables, data_j, g_out_j, training=True)
+        for key in ("fake_out_trans", "real_out_style", "fake_out_recon"):
+            np.testing.assert_allclose(np.asarray(got[key]),
+                                       to_nhwc(want[key]),
+                                       rtol=2e-3, atol=2e-4, err_msg=key)
+        for key in ("fake_features_trans", "real_features_style"):
+            np.testing.assert_allclose(np.asarray(got[key]),
+                                       t2j(want[key]),
+                                       rtol=2e-3, atol=2e-4, err_msg=key)
