@@ -8,9 +8,11 @@ loss. A weight-shared variant reuses one patch D across scales
 
 TPU-first: the pyramid loop is a static Python loop over ``num_discriminators``
 (unrolled at trace time); each level is a stack of stride-2 convs that XLA
-tiles onto the MXU. Downsampling uses jax.image bilinear (half-pixel
-centers; the reference uses align_corners=True — a sub-pixel sampling
-difference that only matters for bit-exact weight ports).
+tiles onto the MXU. Downsampling uses the reference's
+align_corners=True bilinear sampling convention (gather-based 1-D
+interps, fused by XLA), so ported weights see numerically matching
+pyramids (float32-close; same sampling positions) — pinned by the
+full-pyramid goldens in tests/test_reference_goldens.py.
 """
 
 from __future__ import annotations
@@ -18,7 +20,6 @@ from __future__ import annotations
 import math
 from typing import Any
 
-import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -31,8 +32,36 @@ from imaginaire_tpu.utils.data import (
 
 
 def _downsample2x_bilinear(x):
-    n, h, w, c = x.shape
-    return jax.image.resize(x, (n, h // 2, w // 2, c), method="bilinear")
+    """Half-resolution bilinear with ALIGN-CORNERS sampling — the exact
+    convention of the reference pyramid
+    (ref: multires_patch.py:166-171, F.interpolate align_corners=True):
+    output pixel i samples input position i*(n_in-1)/(n_out-1). Pinned
+    by full-pyramid weight-port goldens (test_reference_goldens.py);
+    jax.image.resize's half-pixel convention differs at the edges."""
+    _, h, w, _ = x.shape
+    return _resize_bilinear_align_corners(x, h // 2, w // 2)
+
+
+def _resize_bilinear_align_corners(x, out_h, out_w):
+    _, h, w, _ = x.shape
+
+    def axis(n_in, n_out):
+        if n_out > 1:
+            pos = jnp.arange(n_out) * ((n_in - 1) / (n_out - 1))
+        else:
+            pos = jnp.zeros((1,))
+        i0 = jnp.floor(pos).astype(jnp.int32)
+        i1 = jnp.minimum(i0 + 1, n_in - 1)
+        frac = (pos - i0).astype(x.dtype)
+        return i0, i1, frac
+
+    i0, i1, fh = axis(h, out_h)
+    x = x[:, i0] * (1 - fh)[None, :, None, None] \
+        + x[:, i1] * fh[None, :, None, None]
+    j0, j1, fw = axis(w, out_w)
+    x = x[:, :, j0] * (1 - fw)[None, None, :, None] \
+        + x[:, :, j1] * fw[None, None, :, None]
+    return x
 
 
 class NLayerPatchDiscriminator(nn.Module):

@@ -35,9 +35,6 @@ generator's ``self.xy.cuda()``) only unblock imports — they change no math.
 
 Known, documented convention differences are scoped OUT of these goldens
 rather than papered over:
-  - multires pyramid downsample: reference uses align_corners=True
-    bilinear; ours uses half-pixel (see multires_patch.py docstring).
-    The full-D golden therefore runs num_discriminators=1 (no pyramid).
   - nearest-resize index convention for label maps: goldens feed label
     maps that are piecewise-constant on 16x16-aligned blocks, so every
     power-of-two nearest resize agrees under either convention. (The
@@ -637,7 +634,7 @@ class TestSpadeDiscriminatorGolden:
 
         dis_cfg = _t.SimpleNamespace(
             kernel_size=3, num_filters=nf, max_num_filters=4 * nf,
-            num_discriminators=1, num_layers=2, activation_norm_type="none",
+            num_discriminators=2, num_layers=2, activation_norm_type="none",
             weight_norm_type="spectral")
         data_cfg = _t.SimpleNamespace(
             type="imaginaire.datasets.paired_images",
@@ -651,7 +648,7 @@ class TestSpadeDiscriminatorGolden:
         tdis.train()
 
         jdis_cfg = {"kernel_size": 3, "num_filters": nf,
-                    "max_num_filters": 4 * nf, "num_discriminators": 1,
+                    "max_num_filters": 4 * nf, "num_discriminators": 2,
                     "num_layers": 2, "activation_norm_type": "none",
                     "weight_norm_type": "spectral"}
         jdata_cfg = {
@@ -1193,3 +1190,74 @@ class TestFunitDiscriminatorGolden:
             np.testing.assert_allclose(np.asarray(got[key]),
                                        t2j(want[key]),
                                        rtol=2e-3, atol=2e-4, err_msg=key)
+
+
+class TestMultiResPatchDiscriminatorGolden:
+    """Full 2-scale pyramid goldens for the standalone multires patch
+    discriminators — plain and weight-shared — including the
+    align-corners bilinear downsample between scales
+    (ref: imaginaire/discriminators/multires_patch.py:103-242)."""
+
+    NF, NL, ND = 4, 2, 2
+
+    def _convert_patch_d(self, td):
+        dp, ds = {}, {}
+        n_blocks = len(list(td.named_children()))
+        for li in range(n_blocks):
+            seq = getattr(td, f"layer{li}")
+            p, s, _ = convert_conv_block(seq[0])
+            dp[f"layer{li}"] = p
+            if s:
+                ds[f"layer{li}"] = s
+        return dp, ds
+
+    @pytest.mark.parametrize("shared", [False, True])
+    def test_pyramid_matches_reference(self, ref, shared):
+        from imaginaire.discriminators import multires_patch as ref_mrp
+
+        from imaginaire_tpu.models.discriminators.multires_patch import (
+            MultiResPatchDiscriminator,
+        )
+
+        torch.manual_seed(22)
+        cls = (ref_mrp.WeightSharedMultiResPatchDiscriminator if shared
+               else ref_mrp.MultiResPatchDiscriminator)
+        tdis = cls(num_discriminators=self.ND, kernel_size=3,
+                   num_image_channels=3, num_filters=self.NF,
+                   num_layers=self.NL, max_num_filters=4 * self.NF,
+                   activation_norm_type="", weight_norm_type="spectral")
+        tdis.train()
+        jdis = MultiResPatchDiscriminator(
+            num_discriminators=self.ND, kernel_size=3,
+            num_filters=self.NF, num_layers=self.NL,
+            max_num_filters=4 * self.NF, activation_norm_type="",
+            weight_norm_type="spectral", weight_shared=shared)
+        rng = np.random.RandomState(23)
+        x = rng.randn(2, 32, 32, 3).astype(np.float32) * 0.5
+        variables = jdis.init(jax.random.PRNGKey(0), x, training=True)
+        params, spectral = {}, {}
+        if shared:
+            dp, ds = self._convert_patch_d(tdis.discriminator)
+            params["d_shared"] = dp
+            if ds:
+                spectral["d_shared"] = ds
+        else:
+            for i, td in enumerate(tdis.discriminators):
+                dp, ds = self._convert_patch_d(td)
+                params[f"d_{i}"] = dp
+                if ds:
+                    spectral[f"d_{i}"] = ds
+        variables = _merge_variables(variables, params, spectral)
+        want_out, want_feat, _ = tdis(nchw(x))
+        (got_out, got_feat, _), _ = jdis.apply(
+            variables, x, training=True, mutable=["spectral"])
+        assert len(got_out) == len(want_out) == self.ND
+        for scale, (g, w) in enumerate(zip(got_out, want_out)):
+            np.testing.assert_allclose(
+                np.asarray(g), to_nhwc(w), rtol=2e-3, atol=2e-4,
+                err_msg=f"logits scale {scale}")
+        for scale in range(self.ND):
+            for g, w in zip(got_feat[scale], want_feat[scale]):
+                np.testing.assert_allclose(
+                    np.asarray(g), to_nhwc(w), rtol=2e-3, atol=2e-4,
+                    err_msg=f"features scale {scale}")
