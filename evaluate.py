@@ -61,6 +61,17 @@ def main():
     sample = trainer.start_of_iteration(sample, 0)
     trainer.init_state(jax.random.PRNGKey(args.seed), sample)
 
+    # The metric sweeps below device-prefetch the val loader internally
+    # (trainer.data_prefetcher honors data.device_prefetch): the next
+    # batch's host load + H2D overlaps the extractor/generator on the
+    # current one. Video-family sweeps stay frame-sequential by design
+    # (per-sequence pinned datasets mutate between windows).
+    from imaginaire_tpu.data.device_prefetch import prefetch_settings
+
+    pf_on, pf_depth = prefetch_settings(cfg)
+    print(f"data.device_prefetch: {'on' if pf_on else 'off'} "
+          f"(depth {pf_depth})")
+
     if args.checkpoint:
         checkpoints = [args.checkpoint]
     elif args.checkpoint_logdir:

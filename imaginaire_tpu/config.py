@@ -191,6 +191,12 @@ def default_config():
             type="imaginaire_tpu.data.images",
             num_workers=0,
             prefetch=2,
+            # Async device-prefetch (data/device_prefetch.py): keep
+            # ``depth`` batches resident on device as committed sharded
+            # arrays ahead of the step loop — the jax replacement for
+            # the reference's pin_memory + non_blocking CUDA transfers.
+            # ``enabled: False`` restores the synchronous to_device path.
+            device_prefetch=AttrDict(enabled=True, depth=2),
         ),
         test_data=AttrDict(
             name="dummy",

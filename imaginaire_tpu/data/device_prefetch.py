@@ -1,0 +1,200 @@
+"""Async device-prefetch layer: overlap host batch prep, host->device
+transfer, and XLA step dispatch.
+
+The host ``DataLoader`` already overlaps decode/augment with compute
+(``num_workers`` thread pool), but its batches land on the host — the
+trainer then paid a synchronous, uncommitted ``jnp.asarray`` transfer at
+the top of every iteration (``to_device``), stalling the step dispatch
+for the full H2D latency. ``DevicePrefetcher`` closes that gap, the
+jax analogue of the reference's ``pin_memory=True`` +
+``.cuda(non_blocking=True)`` pair: a producer thread pulls host
+batches, runs the trainer's host-side ``_start_of_iteration`` hook,
+splits numeric leaves from host-only entries (``numeric_only``
+semantics — strings, per-sample key lists, '_'-prefixed host payloads
+stay put), and issues ``jax.device_put`` with committed
+``NamedSharding(mesh, P('data', ...))`` specs so arrays arrive already
+laid out for the SPMD step program — no post-hoc redistribution inside
+jit. A bounded queue keeps up to ``depth`` batches resident on device
+ahead of the consumer.
+
+Observability: per-batch ``data/host_wait_ms`` (producer blocked on the
+host loader), ``data/transfer_ms`` (device_put dispatch) and
+``data/queue_depth`` (ready batches at consume time) accumulate in a
+lock-guarded buffer; ``drain_stats()`` hands them to the trainer's
+meters, flushed on ``logging_iter`` with the loss meters — nothing here
+ever blocks the step loop on a device sync.
+
+Lifecycle contract (mirrors ``DataLoader._iter_prefetch``): the wrapper
+is re-iterable — each ``__iter__`` spawns a fresh producer; worker
+exceptions travel through the queue and re-raise in the consumer;
+abandoning the iterator early (``break`` / GeneratorExit) sets a stop
+flag and drains the queue so a blocked producer put always unwinds.
+
+Config: the ``data.device_prefetch`` knob ({enabled, depth}, defaults
+on / depth 2) is honored by every family config via the defaults tree;
+with it off, consumers keep the synchronous ``to_device`` path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from imaginaire_tpu.config import cfg_get
+
+
+class PrefetchedBatch(dict):
+    """Marker type for batches a ``DevicePrefetcher`` produced: the
+    host-side ``_start_of_iteration`` hook already ran and numeric
+    leaves are committed device arrays — consumers must skip their own
+    preprocess + transfer (``BaseTrainer.start_of_iteration`` does)."""
+
+
+def prefetch_settings(cfg):
+    """(enabled, depth) from the ``data.device_prefetch`` config knob.
+
+    Accepts a missing knob (defaults on, depth 2), a bare bool, or the
+    {enabled, depth} mapping the defaults tree carries.
+    """
+    pcfg = cfg_get(cfg_get(cfg, "data", {}) or {}, "device_prefetch", None)
+    if pcfg is None:
+        return True, 2
+    if isinstance(pcfg, bool):
+        return pcfg, 2
+    return (bool(cfg_get(pcfg, "enabled", True)),
+            max(int(cfg_get(pcfg, "depth", 2)), 1))
+
+
+class DevicePrefetcher:
+    """Wrap a host batch iterable; keep ``depth`` batches on device
+    ahead of the consumer.
+
+    Args:
+        loader: host batch iterable (``DataLoader`` or any iterable of
+            dict batches). ``set_epoch``/``__len__``/``dataset`` pass
+            through when present.
+        host_preprocess: optional ``fn(batch, index) -> batch`` run in
+            the producer thread BEFORE transfer — the trainer's
+            host-side ``_start_of_iteration`` hook. ``index`` counts
+            batches within the current iteration pass, so callers can
+            derive the consuming iteration number.
+        depth: number of batches kept resident on device ahead of the
+            consumer (the queue bound).
+        mesh: mesh for the committed batch sharding; defaults to the
+            process mesh (``peek_mesh``), degrading to uncommitted
+            ``to_device`` placement when none is configured.
+    """
+
+    def __init__(self, loader, host_preprocess=None, depth=2, mesh=None,
+                 axis="data"):
+        self.loader = loader
+        self.host_preprocess = host_preprocess
+        self.depth = max(int(depth), 1)
+        self.mesh = mesh
+        self.axis = axis
+        self._stats_lock = threading.Lock()
+        self._stats = {}
+
+    # ------------------------------------------------- loader passthrough
+
+    def set_epoch(self, epoch):
+        if hasattr(self.loader, "set_epoch"):
+            self.loader.set_epoch(epoch)
+
+    def __len__(self):
+        return len(self.loader)
+
+    @property
+    def dataset(self):
+        return getattr(self.loader, "dataset", None)
+
+    # ------------------------------------------------------ observability
+
+    def _record(self, name, value):
+        with self._stats_lock:
+            self._stats.setdefault(name, []).append(float(value))
+
+    def drain_stats(self):
+        """Pop accumulated {meter_name: [values]} — plain host floats,
+        safe to write into meters without a device sync."""
+        with self._stats_lock:
+            out, self._stats = self._stats, {}
+        return out
+
+    # ------------------------------------------------------------ pipeline
+
+    def _transfer(self, batch):
+        """Split host-only leaves out, commit the numeric remainder as
+        sharded device arrays, re-merge. Non-dict batches place whole."""
+        from imaginaire_tpu.parallel.sharding import place_committed_batch
+        from imaginaire_tpu.utils.misc import merge_host_leaves, \
+            split_host_leaves
+
+        if not isinstance(batch, dict):
+            return place_committed_batch(batch, mesh=self.mesh,
+                                         axis=self.axis)
+        numeric, host = split_host_leaves(batch)
+        placed = place_committed_batch(numeric, mesh=self.mesh,
+                                       axis=self.axis)
+        return PrefetchedBatch(merge_host_leaves(placed, host))
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        sentinel = object()
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+        def produce():
+            try:
+                source = iter(self.loader)
+                index = 0
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    try:
+                        batch = next(source)
+                    except StopIteration:
+                        return
+                    self._record("data/host_wait_ms",
+                                 (time.perf_counter() - t0) * 1e3)
+                    if self.host_preprocess is not None:
+                        batch = self.host_preprocess(batch, index)
+                    t1 = time.perf_counter()
+                    batch = self._transfer(batch)
+                    self._record("data/transfer_ms",
+                                 (time.perf_counter() - t1) * 1e3)
+                    put(batch)
+                    index += 1
+            except BaseException as e:  # forwarded to the consumer
+                put(e)
+            finally:
+                put(sentinel)
+
+        producer = threading.Thread(target=produce, daemon=True,
+                                    name="device-prefetch")
+        producer.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                # depth actually in use: this batch + what is still queued
+                self._record("data/queue_depth", q.qsize() + 1)
+                yield item
+        finally:
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            producer.join(timeout=10)

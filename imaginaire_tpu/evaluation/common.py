@@ -55,7 +55,11 @@ def get_activations(data_loader, key_real, key_fake, extractor,
         if max_batches is not None and it >= max_batches:
             break
         if generator_fn is None:
-            images = jnp.asarray(np.asarray(data[key_real]))
+            # device-prefetched batches are already placed jax arrays;
+            # only host batches need the numpy->device hop
+            images = data[key_real]
+            if not isinstance(images, jax.Array):
+                images = jnp.asarray(np.asarray(images))
         else:
             images = generator_fn(data)
         feats = extractor(preprocess_for_inception(images))

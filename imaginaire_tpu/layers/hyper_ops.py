@@ -52,7 +52,7 @@ def per_sample_conv2d(x, w, b=None, stride=1, padding="SAME", dilation=1):
     if (mesh is not None and "data" in mesh.axis_names
             and mesh.shape["data"] > 1
             and x.shape[0] % mesh.shape["data"] == 0):
-        from jax import shard_map
+        from imaginaire_tpu.parallel import shard_map
         from jax.sharding import PartitionSpec as P
 
         spec = P("data")

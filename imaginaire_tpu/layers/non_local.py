@@ -57,7 +57,7 @@ class NonLocal2dBlock(nn.Module):
             k = conv(ch, "phi")(x, training=training).reshape(b, h * w, 1, ch)
             v = conv(cg, "g")(x, training=training).reshape(b, h * w, 1, cg)
             if self.ring_shard_map:
-                from jax import shard_map
+                from imaginaire_tpu.parallel import shard_map
                 from jax.sharding import PartitionSpec as P
 
                 from imaginaire_tpu.parallel.mesh import get_mesh

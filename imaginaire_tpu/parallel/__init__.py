@@ -9,6 +9,13 @@ all-reduce) during SPMD partitioning, riding ICI within a host/pod slice
 and DCN across hosts.
 """
 
+# jax moved shard_map from jax.experimental to the top level; support
+# both so the sharded layers/dryrun run on either side of the move
+try:
+    from jax import shard_map
+except ImportError:  # older jax: the experimental home
+    from jax.experimental.shard_map import shard_map
+
 from imaginaire_tpu.parallel.mesh import (
     create_mesh,
     get_mesh,
@@ -24,10 +31,12 @@ from imaginaire_tpu.parallel.sharding import (
     batch_sharding,
     replicated_sharding,
     shard_batch,
+    place_committed_batch,
     data_axis_size,
 )
 
 __all__ = [
+    "shard_map",
     "create_mesh",
     "get_mesh",
     "set_mesh",
@@ -40,5 +49,6 @@ __all__ = [
     "batch_sharding",
     "replicated_sharding",
     "shard_batch",
+    "place_committed_batch",
     "data_axis_size",
 ]

@@ -19,7 +19,7 @@ from __future__ import annotations
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from imaginaire_tpu.parallel.mesh import DATA_AXIS, get_mesh
+from imaginaire_tpu.parallel.mesh import DATA_AXIS, get_mesh, peek_mesh
 
 
 def replicated_sharding(mesh=None):
@@ -33,21 +33,61 @@ def batch_sharding(mesh=None, axis=DATA_AXIS):
     return NamedSharding(mesh, P(axis))
 
 
-def _batch_spec_for(x, axis):
+def _batch_spec_for(x, axis, axis_size=None):
+    """Leading-dim spec over ``axis``; replicated (P()) for scalars and
+    for leaves whose dim 0 the axis size does not divide (a bs-2 batch on
+    an 8-device mesh must not fail the whole transfer)."""
     if hasattr(x, "ndim") and x.ndim >= 1:
+        if axis_size is not None and (
+                x.shape[0] == 0 or x.shape[0] % axis_size != 0):
+            return P()
         return P(axis, *([None] * (x.ndim - 1)))
     return P()
 
 
 def batch_pytree_shardings(batch, mesh=None, axis=DATA_AXIS):
-    """Per-leaf NamedShardings sharding dim 0 of every array leaf."""
+    """Per-leaf NamedShardings sharding dim 0 of every array leaf
+    (replicated where dim 0 is not divisible by the axis size)."""
     mesh = mesh or get_mesh()
-    return jax.tree.map(lambda x: NamedSharding(mesh, _batch_spec_for(x, axis)), batch)
+    size = mesh.shape[axis]
+    return jax.tree.map(
+        lambda x: NamedSharding(mesh, _batch_spec_for(x, axis, size)), batch)
 
 
 def shard_batch(batch, mesh=None, axis=DATA_AXIS):
     """Device-put a host batch pytree with leading-dim sharding."""
     shardings = batch_pytree_shardings(batch, mesh, axis)
+    return jax.device_put(batch, shardings)
+
+
+def place_committed_batch(batch, mesh=None, axis=DATA_AXIS):
+    """Device-put a numeric batch pytree as COMMITTED ``NamedSharding``
+    arrays over the data mesh axis — the device-prefetch transfer path.
+
+    Arrays arrive already laid out the way the jitted step wants them
+    (batch dim over ``axis``, no post-hoc redistribution inside jit);
+    leaves whose leading dim the axis size does not divide are placed
+    replicated. Without a configured mesh (``peek_mesh()`` is None and
+    no ``mesh`` given) this degrades to ``to_device``'s uncommitted
+    ``jnp.asarray`` placement so single-device scripts keep working.
+    """
+    from imaginaire_tpu.utils.misc import to_device
+
+    mesh = mesh if mesh is not None else peek_mesh()
+    if mesh is None or jax.process_count() > 1:
+        # multi-process: the loader batch is this HOST's slice of the
+        # global batch — committing it with a global-mesh spec would
+        # mislabel local data as the whole batch. The uncommitted path
+        # keeps the established per-host semantics there.
+        return to_device(batch)
+    shardings = batch_pytree_shardings(batch, mesh, axis)
+    specs = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda s: isinstance(s, NamedSharding))
+    if not any(len(s.spec) and s.spec[0] == axis for s in specs):
+        # nothing actually shards (batch dim indivisible everywhere):
+        # committing replicated arrays would only drag every consumer
+        # program onto the full mesh — keep the uncommitted placement
+        return to_device(batch)
     return jax.device_put(batch, shardings)
 
 

@@ -298,13 +298,70 @@ class BaseTrainer:
         self.start_epoch_time = time.time()
 
     def start_of_iteration(self, data, current_iteration):
-        data = self._start_of_iteration(data, current_iteration)
+        from imaginaire_tpu.data.device_prefetch import PrefetchedBatch
+
+        prefetched = isinstance(data, PrefetchedBatch)
+        if not prefetched:
+            data = self._start_of_iteration(data, current_iteration)
         self.current_iteration = current_iteration
         self.start_iteration_time = time.time()
         self._maybe_profile(current_iteration)
+        if prefetched:
+            # a DevicePrefetcher already ran the host hook and committed
+            # the numeric leaves as sharded device arrays — re-running
+            # either would drag them back through the host
+            return data
         from imaginaire_tpu.utils.misc import to_device
 
         return to_device(data)
+
+    def data_prefetcher(self, loader, iteration_of=None):
+        """Wrap ``loader`` in a DevicePrefetcher honoring the
+        ``data.device_prefetch`` knob; the loader comes back unchanged
+        when prefetch is off (the synchronous to_device path) or the
+        loader is already wrapped.
+
+        ``iteration_of``: optional ``index -> current_iteration``
+        mapping handed to the host-side ``_start_of_iteration`` hook
+        (the train loop's epoch-relative counter); metric/test sweeps
+        omit it and the hook sees -1, the side-effect-free mode.
+        """
+        from imaginaire_tpu.data.device_prefetch import (
+            DevicePrefetcher,
+            prefetch_settings,
+        )
+
+        enabled, depth = prefetch_settings(self.cfg)
+        if not enabled or loader is None \
+                or isinstance(loader, DevicePrefetcher):
+            return loader
+
+        def host_preprocess(batch, index):
+            it = iteration_of(index) if iteration_of is not None else -1
+            return self._start_of_iteration(batch, it)
+
+        return DevicePrefetcher(loader, host_preprocess=host_preprocess,
+                                depth=depth)
+
+    def write_data_meters(self, stats):
+        """Record drained DevicePrefetcher stats ({meter: [floats]}) —
+        flushed with the loss meters on logging_iter, never a device
+        sync (values are already host floats)."""
+        for name, values in (stats or {}).items():
+            meter = self._meter(name)
+            for value in values:
+                meter.write(value)
+
+    def _eval_preprocess(self, data):
+        """Side-effect-free per-batch prep for metric sweeps: host hook
+        + transfer, skipped when a DevicePrefetcher already did both."""
+        from imaginaire_tpu.data.device_prefetch import PrefetchedBatch
+
+        if isinstance(data, PrefetchedBatch):
+            return data
+        from imaginaire_tpu.utils.misc import to_device
+
+        return to_device(self._start_of_iteration(data, -1))
 
     def _maybe_profile(self, current_iteration):
         """XLA profiler trace window (the jax-native replacement for the
@@ -630,6 +687,9 @@ class BaseTrainer:
         os.makedirs(output_dir, exist_ok=True)
         inference_args = inference_args or {}
         variables = self.inference_params()
+        # overlap the next batch's host load + H2D with this batch's
+        # generate (start_of_iteration skips re-prep for wrapped batches)
+        data_loader = self.data_prefetcher(data_loader)
         for it, data in enumerate(data_loader):
             data = self.start_of_iteration(data, current_iteration=-1)
             images = self.net_G.apply(

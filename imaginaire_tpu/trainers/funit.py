@@ -124,10 +124,14 @@ class Trainer(BaseTrainer):
 
         logdir = cfg_get(self.cfg, "logdir", ".")
         fids = []
+        # device-prefetched sweep: each compute_fid opens fresh passes,
+        # so the per-class dataset re-pinning below stays race-free (the
+        # producer only reads ahead within one pass)
+        val_loader = self.data_prefetcher(self.val_data_loader)
         for class_idx in range(dataset.num_style_classes):
             dataset.set_sample_class_idx(class_idx)
             path = os.path.join(logdir, f"real_stats_style{class_idx}.npz")
-            fids.append(compute_fid(path, self.val_data_loader, extractor,
+            fids.append(compute_fid(path, val_loader, extractor,
                                     gen_fn, key_real="images_style"))
         dataset.set_sample_class_idx(None)
         return float(np.mean(fids))

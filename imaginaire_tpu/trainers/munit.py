@@ -221,10 +221,13 @@ class Trainer(BaseTrainer):
             return fn
 
         fids = {}
+        # device-prefetched sweep (gen_fn's to_device is a no-op on the
+        # already-placed batches)
+        val_loader = self.data_prefetcher(self.val_data_loader)
         for domain, a2b, real_key in (("a", False, "images_a"),
                                       ("b", True, "images_b")):
             path = os.path.join(logdir, f"real_stats_{domain}.npz")
-            fids[domain] = compute_fid(path, self.val_data_loader, extractor,
+            fids[domain] = compute_fid(path, val_loader, extractor,
                                        gen_fn(a2b), key_real=real_key)
             self._meter(f"FID_{domain}").write(float(fids[domain]))
         return 0.5 * (fids["a"] + fids["b"])

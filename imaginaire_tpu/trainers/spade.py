@@ -196,7 +196,7 @@ class Trainer(BaseTrainer):
         Uses the side-effect-free _start_of_iteration (the full hook
         would clobber current_iteration/timers mid-metrics)."""
         def gen_fn(data):
-            data = to_device(self._start_of_iteration(data, -1))
+            data = self._eval_preprocess(data)
             out, _ = self._apply_G(variables, data, jax.random.PRNGKey(0),
                                    training=False)
             return out["fake_images"]
@@ -209,13 +209,17 @@ class Trainer(BaseTrainer):
         from imaginaire_tpu.evaluation.common import get_activations
 
         gen_fn = self._make_eval_gen_fn(self.inference_params())
-        act_fake = get_activations(self.val_data_loader, "images",
+        # device-prefetch the sweep: the next batch transfers while the
+        # extractor chews on this one (gen_fn skips re-prep for wrapped
+        # batches)
+        val_loader = self.data_prefetcher(self.val_data_loader)
+        act_fake = get_activations(val_loader, "images",
                                    "fake_images", extractor,
                                    generator_fn=gen_fn)
         data_name = cfg_get(cfg_get(self.cfg, "data", {}), "name", "data")
         act_real = self._cached_real_activations(
             f"real_acts_{data_name}.npz",
-            lambda: get_activations(self.val_data_loader, "images",
+            lambda: get_activations(val_loader, "images",
                                     "fake_images", extractor))
         return act_real, act_fake
 
@@ -238,12 +242,13 @@ class Trainer(BaseTrainer):
         data_name = cfg_get(cfg_get(self.cfg, "data", {}), "name", "data")
         fid_path = os.path.join(logdir, f"real_stats_{data_name}.npz")
 
-        fid = compute_fid(fid_path, self.val_data_loader, extractor,
+        val_loader = self.data_prefetcher(self.val_data_loader)
+        fid = compute_fid(fid_path, val_loader, extractor,
                           self._make_eval_gen_fn(self.state["vars_G"]))
         if self.model_average:
             self.recalculate_model_average_batch_norm_statistics()
             fid_ema = compute_fid(
-                fid_path, self.val_data_loader, extractor,
+                fid_path, val_loader, extractor,
                 self._make_eval_gen_fn(self.inference_params()))
             self._meter("FID_ema").write(float(fid_ema))
         return fid

@@ -522,7 +522,36 @@ class Trainer(BaseTrainer):
                 # per-frame path (the tail slices labels along time)
                 and data_t_accounted
                 and cls._frame_override is Trainer._frame_override
-                and cls._after_gen_frame is Trainer._after_gen_frame)
+                and cls._after_gen_frame is Trainer._after_gen_frame
+                and self._scan_keys_consistent(data, seq_len))
+
+    def _scan_keys_consistent(self, data, seq_len):
+        """Runtime cross-check of the ``_rollout_scan_constants``
+        pairing: probe ``_get_data_t`` at a steady-state frame and
+        require every key it emits to be one the scan body rebuilds
+        (label/image/real_prev_image/prev_*/past_stacks) or a declared
+        constant. An override whose extra keys vary per frame would
+        otherwise silently train the tail on stale constants — disable
+        the scan instead. Verdict cached per batch key-set (the probe
+        slices device arrays; once per data layout is enough)."""
+        cache_key = tuple(sorted(str(k) for k in data))
+        cached = getattr(self, "_scan_key_verdict", None)
+        if cached is not None and cached[0] == cache_key:
+            return cached[1]
+        t_probe = min(max(self.num_frames_G - 1, 1), seq_len - 1)
+        probe = self._get_data_t(data, t_probe,
+                                 data["label"][:, :1],
+                                 data["images"][:, :1])
+        rebuilt = {"label", "image", "prev_labels", "prev_images",
+                   "real_prev_image", "past_stacks"}
+        rebuilt |= set(self._rollout_scan_constants(data))
+        extra = sorted(k for k in probe
+                       if not str(k).startswith("_") and k not in rebuilt)
+        if extra:
+            print(f"rollout_scan disabled: _get_data_t emits per-frame "
+                  f"keys {extra} the scan tail would not rebuild")
+        self._scan_key_verdict = (cache_key, not extra)
+        return not extra
 
     def gen_update(self, data):
         """Interleaved per-frame D/G rollout (ref: vid2vid.py:238-288).

@@ -105,14 +105,16 @@ def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None,
         # never observe pointer-before-commit. The thread handle is kept
         # so wait_for_pending_checkpoint can join it — otherwise a later
         # save's pointer could be overwritten by this older thread, or
-        # the write lost at process exit. A pointer-write failure is
-        # stashed on the thread and re-raised at the join, never
-        # swallowed (a stale pointer would silently lose progress).
+        # the write lost at process exit. Both a commit failure and a
+        # pointer-write failure are stashed on the thread and re-raised
+        # at the join, never swallowed — and the pointer is only written
+        # when the commit actually succeeded, so it can never name a
+        # checkpoint that failed to finalize.
         import threading
 
         def _commit_then_point():
-            ckpt.wait_until_finished()
             try:
+                ckpt.wait_until_finished()
                 _write_pointer()
             except BaseException as e:  # re-raised by the joiner
                 _commit_then_point.error = e
@@ -142,8 +144,9 @@ def wait_for_pending_checkpoint():
         err = getattr(thread._pointer_fn, "error", None)
         if err is not None:
             raise RuntimeError(
-                "checkpoint pointer write failed; latest_checkpoint.txt "
-                "is stale") from err
+                "async checkpoint commit or pointer write failed; "
+                "latest_checkpoint.txt still names the previous complete "
+                "checkpoint") from err
 
 
 def latest_checkpoint_path(logdir):

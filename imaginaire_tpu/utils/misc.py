@@ -84,6 +84,60 @@ def to_device(tree):
         and not isinstance(x, np.ndarray))
 
 
+def split_host_leaves(tree):
+    """Split a batch dict into (numeric, host) halves with the
+    ``numeric_only`` key semantics: the numeric half is safe to
+    ``jax.device_put`` (arrays/scalars), the host half carries everything
+    that must stay on the host — strings/bytes, per-sample 'key' lists,
+    '_'-prefixed host-object entries (wc-vid2vid point-cloud payloads),
+    object-dtype arrays. ``merge_host_leaves`` re-zips the halves.
+
+    Used by the device-prefetch pipeline: the numeric half ships to
+    device as committed sharded arrays in the producer thread while the
+    host half rides alongside untouched.
+    """
+    import numpy as np
+
+    if not isinstance(tree, dict):
+        return tree, None
+    numeric, host = {}, {}
+    for k, v in tree.items():
+        if isinstance(k, str) and k.startswith("_"):
+            host[k] = v
+        elif isinstance(v, dict):
+            sub_num, sub_host = split_host_leaves(v)
+            if sub_num:
+                numeric[k] = sub_num
+            if sub_host:
+                host[k] = sub_host
+        elif isinstance(v, (str, bytes)):
+            host[k] = v
+        elif isinstance(v, (list, tuple)):
+            host[k] = v
+        elif isinstance(v, np.ndarray) and v.dtype == object:
+            host[k] = v
+        elif isinstance(v, (np.ndarray, int, float, np.number)) \
+                or hasattr(v, "dtype"):
+            numeric[k] = v
+        else:
+            host[k] = v
+    return numeric, host
+
+
+def merge_host_leaves(numeric, host):
+    """Inverse of ``split_host_leaves``: overlay the host half back onto
+    the (device-placed) numeric half. Returns a plain dict tree."""
+    if not host:
+        return numeric
+    out = dict(numeric or {})
+    for k, v in host.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = merge_host_leaves(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
 def numeric_only(tree):
     """Drop non-array entries (sample keys, filenames) from a data dict so
     the remainder is a valid jit argument. Recurses into dicts only —

@@ -35,7 +35,7 @@ class TestRingAttention:
         k = jnp.asarray(rng.randn(b, n, h, d).astype(np.float32))
         v = jnp.asarray(rng.randn(b, n, h, d).astype(np.float32))
 
-        from jax import shard_map
+        from imaginaire_tpu.parallel import shard_map
 
         ring = shard_map(
             lambda q_, k_, v_: ring_attention(q_, k_, v_, "seq"),
@@ -55,7 +55,7 @@ class TestRingAttention:
         k = jnp.asarray(rng.randn(b, n, h, d).astype(np.float32))
         v = jnp.asarray(rng.randn(b, n, h, d).astype(np.float32))
 
-        from jax import shard_map
+        from imaginaire_tpu.parallel import shard_map
 
         ring = shard_map(
             lambda q_, k_, v_: ring_attention(q_, k_, v_, "seq"),
@@ -79,7 +79,7 @@ class TestRingAttention:
         b, h, w, c = 1, 16, 8, 32  # rows sharded: 2 rows per device
         x = jnp.asarray(rng.randn(b, h, w, c).astype(np.float32))
 
-        from jax import shard_map
+        from imaginaire_tpu.parallel import shard_map
 
         ring = shard_map(
             lambda x_: ring_self_attention_2d(x_, "seq", num_heads=4),
@@ -94,7 +94,7 @@ class TestRingAttention:
         """NonLocal2dBlock(ring_axis=..., ring_shard_map=False) runs
         inside an outer shard_map with rows sharded, using params
         initialized by the ring-free twin."""
-        from jax import shard_map
+        from imaginaire_tpu.parallel import shard_map
 
         from imaginaire_tpu.layers.non_local import NonLocal2dBlock
 

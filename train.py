@@ -75,17 +75,32 @@ def main():
     dis_steps = cfg_get(cfg.trainer, "dis_step", 1)
     gen_steps = cfg_get(cfg.trainer, "gen_step", 1)
 
+    # Async device prefetch (data/device_prefetch.py): a producer thread
+    # runs the host-side _start_of_iteration hook and commits batches to
+    # device as sharded arrays while the previous step computes, so the
+    # loop below never blocks on H2D. The epoch_base cell hands the hook
+    # the iteration each read-ahead batch will be consumed at. With
+    # data.device_prefetch off, feed is the loader and
+    # start_of_iteration keeps the synchronous to_device transfer.
+    epoch_base = [current_iteration]
+    feed = trainer.data_prefetcher(
+        train_loader, iteration_of=lambda index: epoch_base[0] + index)
+    prefetching = feed is not train_loader
+
     for epoch in range(current_epoch, max_epoch):
         print(f"Epoch {epoch} ...")
         train_loader.set_epoch(epoch)
         trainer.start_of_epoch(epoch)
-        for it, data in enumerate(train_loader):
+        epoch_base[0] = current_iteration
+        for it, data in enumerate(feed):
             data = trainer.start_of_iteration(data, current_iteration)
             for _ in range(dis_steps):
                 trainer.dis_update(data)
             for _ in range(gen_steps):
                 trainer.gen_update(data)
             current_iteration += 1
+            if prefetching:
+                trainer.write_data_meters(feed.drain_stats())
             trainer.end_of_iteration(data, epoch, current_iteration)
             if current_iteration >= max_iter:
                 print("Done with training!!!")
