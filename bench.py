@@ -32,6 +32,29 @@ import time
 import numpy as np
 
 V100_IMGS_PER_SEC = 3.91
+
+
+def _bench_telemetry():
+    """In-memory telemetry for bench legs: spans/ring buffers on, no
+    sinks, no auto-flush — window_summary() is read per leg so bench
+    rounds and training telemetry share one schema (DATABENCH/VIDBENCH
+    carry the same step p50/p99 + data_wait share a run's
+    telemetry.jsonl does)."""
+    from imaginaire_tpu import telemetry
+
+    return telemetry.configure(enabled=True, sinks=[],
+                               flush_every_n_steps=0, mfu=False)
+
+
+def _leg_summary(tm):
+    """Slim window_summary for the bench JSON sidecars."""
+    s = tm.window_summary()
+    keep = ("duration_s", "steps", "step_ms_p50", "step_ms_p99",
+            "data_wait_share_pct", "imgs_per_sec")
+    out = {k: s[k] for k in keep if k in s}
+    out["phase_total_ms"] = {name: row["total_ms"]
+                             for name, row in s.get("phases", {}).items()}
+    return out
 ZOO_CONFIG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "configs", "projects", "spade", "cocostuff",
                           "base128_bs4.yaml")
@@ -167,6 +190,7 @@ def run_vid2vid(seq_len=4):
     import jax
     import jax.numpy as jnp
 
+    tm = _bench_telemetry()
     last_error = None
     trainer = data = None
     # the full 512x1024 shape is tried first; the tunneled compile
@@ -206,12 +230,15 @@ def run_vid2vid(seq_len=4):
             if bad:
                 raise SystemExit(f"non-finite losses at bs={bs}: {bad}")
             iters = 4
+            tm.reset_window()
             t0 = time.time()
-            for _ in range(iters):
+            for i in range(iters):
                 trainer.dis_update(data)
                 trainer.gen_update(data)
+                tm.step_complete(i, items=bs * seq_len)
             sync()
             dt = time.time() - t0
+            leg_telemetry = _leg_summary(tm)
             frames_per_sec = bs * seq_len * iters / dt
             # same recipe with the whole-rollout scan tail
             # (trainer.rollout_scan) for the head-to-head record;
@@ -267,7 +294,9 @@ def run_vid2vid(seq_len=4):
                                    round(scan_frames_per_sec, 3)
                                    if scan_frames_per_sec else None),
                                per_frame_step_ms=round(
-                                   dt * 1e3 / (bs * seq_len * iters), 2)),
+                                   dt * 1e3 / (bs * seq_len * iters), 2),
+                               leg_duration_s=round(dt, 3),
+                               leg_telemetry=leg_telemetry),
                           f, indent=1)
             print(json.dumps(payload))
             return
@@ -569,6 +598,8 @@ def _pipeline_ab(cfg, iters=10):
                 trainer.state["vars_G"]["params"])[0]))
         return g_losses
 
+    tm = _bench_telemetry()
+
     def measure(feed_iter, warm=2):
         first = trainer.start_of_iteration(next(feed_iter), 0)
         if trainer.state is None:
@@ -578,29 +609,32 @@ def _pipeline_ab(cfg, iters=10):
                if not np.isfinite(float(jnp.asarray(v)))]
         if bad:
             raise SystemExit(f"non-finite losses (pipeline leg): {bad}")
+        tm.reset_window()
         t0 = time.time()
-        for _ in range(iters):
-            steps(trainer.start_of_iteration(next(feed_iter), 0), 1,
-                  sync=False)
+        for i in range(iters):
+            with tm.span("data_wait"):
+                batch = next(feed_iter)
+            steps(trainer.start_of_iteration(batch, 0), 1, sync=False)
+            tm.step_complete(i, items=bs)
         float(jnp.sum(jax.tree_util.tree_leaves(
             trainer.state["vars_G"]["params"])[0]))
-        return bs * iters / (time.time() - t0)
+        return bs * iters / (time.time() - t0), _leg_summary(tm)
 
     # leg 1 — synchronous pipeline feed (device_prefetch off: raw loader
     # batches through start_of_iteration's blocking to_device)
     sync_iter = iter(cycler)
-    sync_rate = measure(sync_iter)
+    sync_rate, sync_tm = measure(sync_iter)
     sync_iter.close()
 
     # leg 2 — device-prefetched feed: host decode + H2D of the next
     # batches overlap the running step programs
     prefetcher = trainer.data_prefetcher(cycler)
     if prefetcher is cycler:  # data.device_prefetch off in the config
-        prefetch_rate, meters = sync_rate, {}
+        prefetch_rate, meters, prefetch_tm = sync_rate, {}, sync_tm
     else:
         prefetcher.drain_stats()
         pf_iter = iter(prefetcher)
-        prefetch_rate = measure(pf_iter, warm=2)
+        prefetch_rate, prefetch_tm = measure(pf_iter, warm=2)
         meters = {name: round(sum(vals) / max(len(vals), 1), 3)
                   for name, vals in prefetcher.drain_stats().items()}
         pf_iter.close()
@@ -611,9 +645,11 @@ def _pipeline_ab(cfg, iters=10):
         jax.tree_util.tree_map(np.asarray, batch_of(bs, label_ch)))
     jax.block_until_ready(data)
     steps(data, 2)
+    tm.reset_window()
     t0 = time.time()
     steps(data, iters)
     synth_rate = bs * iters / (time.time() - t0)
+    synth_tm = _leg_summary(tm)
 
     trainer.state = None
     _, depth = prefetch_settings(cfg)
@@ -628,6 +664,11 @@ def _pipeline_ab(cfg, iters=10):
             (synth_rate - sync_rate) / synth_rate * 100.0, 2),
         "prefetch_depth": depth,
         "data_meters_mean": meters,
+        # per-leg wall duration + telemetry summary — the same
+        # step-p50/p99 / data_wait-share schema a training run's
+        # telemetry.jsonl carries (ISSUE 2 satellite)
+        "leg_telemetry": {"sync": sync_tm, "prefetch": prefetch_tm,
+                          "synthetic": synth_tm},
     }
 
 

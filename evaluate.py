@@ -13,6 +13,7 @@ import os
 
 import jax
 
+from imaginaire_tpu import telemetry
 from imaginaire_tpu.config import Config
 from imaginaire_tpu.data import get_train_and_val_dataloader
 from imaginaire_tpu.parallel.mesh import (
@@ -51,6 +52,9 @@ def main():
     date_uid, logdir = init_logging(args.config, args.logdir)
     make_logging_dir(logdir)
     cfg.logdir = logdir
+    # eval sweeps emit ckpt_load / eval / data_wait spans into the same
+    # telemetry.jsonl schema as training runs
+    telemetry.configure(cfg, logdir=logdir)
 
     train_loader, val_loader = get_train_and_val_dataloader(cfg,
                                                             seed=args.seed)
@@ -107,6 +111,7 @@ def main():
                 "set without sequence pinning)")
         for name, value in extra.items():
             print(f"  {name}: {value:.5f}")
+    telemetry.get().shutdown()
     print("Done with evaluation!!!")
 
 

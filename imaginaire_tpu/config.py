@@ -203,6 +203,24 @@ def default_config():
             type="imaginaire_tpu.data.images",
             num_workers=0,
         ),
+        # -- structured run telemetry (telemetry/): step-phase spans +
+        # derived counters (imgs/sec, step p50/p99, MFU) fanned out to
+        # pluggable sinks; jsonl writes <logdir>/telemetry.jsonl and
+        # tensorboard forwards counters into the meters writer.
+        # hang_timeout_s > 0 arms the watchdog (all-thread stack dump
+        # when no step completes in time); trace_at_step=N captures a
+        # jax.profiler trace for steps [N, N+trace_num_steps).
+        telemetry=AttrDict(
+            enabled=True,
+            sinks=["jsonl", "tensorboard"],
+            flush_every_n_steps=50,
+            ring_size=512,
+            hang_timeout_s=0,
+            trace_at_step=None,
+            trace_num_steps=5,
+            mfu=True,  # one-time XLA cost analysis of the step programs
+            peak_flops=None,  # None => per-device-kind table (v5e default)
+        ),
         # -- TPU runtime (replaces ref cudnn/local_rank blocks, config.py:143-150)
         runtime=AttrDict(
             mesh=AttrDict(axes=["data"], shape=None),  # shape None => all devices on 'data'

@@ -153,21 +153,31 @@ class DevicePrefetcher:
                     continue
 
         def produce():
+            # producer-side telemetry spans (prefetch_host / _preprocess
+            # / _transfer) are tagged with this thread's name — the hang
+            # watchdog's stack dump and the phase table both show where
+            # the pipeline actually spends its time, off the step path
+            from imaginaire_tpu import telemetry
+
+            tm = telemetry.get()
             try:
                 source = iter(self.loader)
                 index = 0
                 while not stop.is_set():
                     t0 = time.perf_counter()
-                    try:
-                        batch = next(source)
-                    except StopIteration:
-                        return
+                    with tm.span("prefetch_host"):
+                        try:
+                            batch = next(source)
+                        except StopIteration:
+                            return
                     self._record("data/host_wait_ms",
                                  (time.perf_counter() - t0) * 1e3)
                     if self.host_preprocess is not None:
-                        batch = self.host_preprocess(batch, index)
+                        with tm.span("prefetch_preprocess"):
+                            batch = self.host_preprocess(batch, index)
                     t1 = time.perf_counter()
-                    batch = self._transfer(batch)
+                    with tm.span("prefetch_transfer"):
+                        batch = self._transfer(batch)
                     self._record("data/transfer_ms",
                                  (time.perf_counter() - t1) * 1e3)
                     put(batch)

@@ -1,0 +1,131 @@
+"""Render a run's ``telemetry.jsonl`` into the PROFILE.md-style
+per-phase attribution table, plus the derived counters (imgs/sec, MFU,
+step percentiles) and any hang dumps.
+
+Library half of ``scripts/telemetry_report.py``; also run by the
+``__graft_entry__`` dryrun so every dryrun prints a phase breakdown.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def load_events(path):
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue  # a torn final line from a killed run
+    return events
+
+
+def _percentile(samples, q):
+    ordered = sorted(samples)
+    idx = min(int(q * (len(ordered) - 1) + 0.5), len(ordered) - 1)
+    return ordered[idx]
+
+
+def summarize(events):
+    """Aggregate events into {phases, counters, meta, hangs, wall_s}.
+
+    Span events nested under a same-named parent are skipped (they are
+    the same wall time measured twice — e.g. a caller's ``data_wait``
+    wrapping ``start_of_iteration``'s own). Phases can still legitimately
+    nest under *different* names (vid2vid's per-frame ``dis_step`` runs
+    inside ``gen_step``), so phase shares may sum past 100%.
+    """
+    phases = {}
+    counters = {}
+    meta = {}
+    hangs = []
+    t_min = t_max = None
+    for ev in events:
+        kind = ev.get("kind")
+        t = ev.get("t")
+        if isinstance(t, (int, float)):
+            t_end = t + (ev.get("dur_ms", 0) or 0) / 1e3
+            t_min = t if t_min is None else min(t_min, t)
+            t_max = t_end if t_max is None else max(t_max, t_end)
+        if kind == "span":
+            if ev.get("parent") == ev.get("name"):
+                continue
+            entry = phases.setdefault(ev["name"], [])
+            entry.append(float(ev.get("dur_ms", 0) or 0))
+        elif kind == "counter":
+            counters[ev["name"]] = (ev.get("value"), ev.get("step"))
+        elif kind == "meta":
+            meta[ev.get("name", "?")] = ev
+        elif kind == "hang":
+            hangs.append(ev)
+    wall_s = (t_max - t_min) if t_min is not None else 0.0
+    table = {}
+    for name, durs in phases.items():
+        table[name] = {
+            "count": len(durs),
+            "total_ms": sum(durs),
+            "mean_ms": sum(durs) / len(durs),
+            "p50_ms": _percentile(durs, 0.50),
+            "p99_ms": _percentile(durs, 0.99),
+            "share_pct": (sum(durs) / (wall_s * 1e3) * 100.0)
+            if wall_s > 0 else 0.0,
+        }
+    return {"phases": table, "counters": counters, "meta": meta,
+            "hangs": hangs, "wall_s": wall_s}
+
+
+def render_report(path_or_events):
+    """Markdown-ish report (the PROFILE.md table format) for a
+    telemetry.jsonl path or a pre-loaded event list."""
+    events = (load_events(path_or_events)
+              if isinstance(path_or_events, str) else path_or_events)
+    s = summarize(events)
+    lines = ["# telemetry phase breakdown",
+             f"wall: {s['wall_s']:.3f}s over {len(events)} events", "",
+             "| phase | count | total ms | mean ms | p50 ms | p99 ms "
+             "| % of wall |",
+             "|---|---|---|---|---|---|---|"]
+    order = sorted(s["phases"].items(),
+                   key=lambda kv: -kv[1]["total_ms"])
+    for name, row in order:
+        lines.append(
+            f"| {name} | {row['count']} | {row['total_ms']:.2f} "
+            f"| {row['mean_ms']:.2f} | {row['p50_ms']:.2f} "
+            f"| {row['p99_ms']:.2f} | {row['share_pct']:.1f}% |")
+    if not s["phases"]:
+        lines.append("| (no spans recorded) | | | | | | |")
+    lines.append("")
+    lines.append("phases nest (vid2vid dis_step runs inside gen_step); "
+                 "durations are dispatch times on async backends — wall "
+                 "and imgs/sec are fenced at flush intervals.")
+
+    perf = {k: v for k, v in s["counters"].items()
+            if k.startswith("perf/")}
+    if perf:
+        lines.append("")
+        lines.append("derived counters (latest):")
+        for name, (value, step) in sorted(perf.items()):
+            if name == "perf/mfu":
+                lines.append(f"- {name}: {value * 100:.2f}% "
+                             f"(step {step})")
+            else:
+                lines.append(f"- {name}: {value:.4g} (step {step})")
+    flops_meta = s["meta"].get("step_flops")
+    if flops_meta:
+        lines.append(f"- step_flops: {flops_meta.get('flops'):.4g} "
+                     f"({flops_meta.get('source')}, peak "
+                     f"{flops_meta.get('peak_flops'):.4g} FLOP/s via "
+                     f"{flops_meta.get('peak_source')})")
+    if s["hangs"]:
+        lines.append("")
+        lines.append(f"!! {len(s['hangs'])} hang dump(s) recorded:")
+        for hang in s["hangs"]:
+            threads = ", ".join(sorted(hang.get("stacks", {})))
+            lines.append(f"- step {hang.get('step')}: "
+                         f"{hang.get('reason')} [threads: {threads}]")
+    return "\n".join(lines)

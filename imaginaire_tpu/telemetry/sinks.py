@@ -1,0 +1,178 @@
+"""Metric sinks: where structured telemetry events go.
+
+A sink consumes the event dicts produced by ``telemetry.core`` (kinds:
+``span`` / ``counter`` / ``meta`` / ``hang``) and persists or displays
+them. Three implementations:
+
+- ``JsonlSink``      — append-only ``<logdir>/telemetry.jsonl``, one JSON
+                       object per line. The canonical machine-readable
+                       record; ``scripts/telemetry_report.py`` renders it.
+- ``TensorBoardSink`` — forwards counter events to the existing
+                       ``utils.meters`` SummaryWriter so derived counters
+                       (imgs/sec, MFU, step percentiles) land on the same
+                       dashboards as the loss meters. No-op without a
+                       writer (torch-free hosts).
+- ``ConsoleSink``    — one compact line of the latest counters per flush
+                       interval, for runs watched from a terminal.
+
+Sinks never see events one-at-a-time on the hot path: ``Telemetry``
+buffers and hands batches over at flush interval (or immediately for
+``hang`` dumps), so a slow sink cannot stall the step loop.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+class Sink:
+    """Base sink: ``emit`` receives one event dict, ``flush`` commits."""
+
+    def emit(self, event):
+        raise NotImplementedError
+
+    def flush(self):
+        pass
+
+    def close(self):
+        self.flush()
+
+
+class JsonlSink(Sink):
+    """Append events to a JSONL file, buffered until ``flush``.
+
+    The file handle opens lazily on the first flush so constructing a
+    telemetry config never touches the filesystem (tests, disabled
+    runs). ``default=str`` keeps exotic leaves (paths, dtypes) from
+    breaking a run just to log them.
+    """
+
+    def __init__(self, path):
+        self.path = path
+        self._lines = []
+        self._fh = None
+
+    def emit(self, event):
+        self._lines.append(json.dumps(event, default=str))
+
+    def flush(self):
+        if not self._lines:
+            return
+        if self._fh is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._fh = open(self.path, "a", buffering=1)
+        self._fh.write("\n".join(self._lines) + "\n")
+        self._fh.flush()
+        self._lines = []
+
+    def close(self):
+        self.flush()
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class TensorBoardSink(Sink):
+    """Forward counter events into the ``utils.meters`` SummaryWriter.
+
+    Wraps the module-level writer (resolved lazily at emit time, so the
+    sink can be built before ``make_logging_dir`` ran) instead of owning
+    one: meter scalars and telemetry counters share a single TB event
+    file. Spans/meta/hang events are skipped — TB has no good primitive
+    for them; the JSONL record is authoritative.
+    """
+
+    def __init__(self, writer=None):
+        self._writer = writer
+
+    def _resolve(self):
+        if self._writer is not None:
+            return self._writer
+        from imaginaire_tpu.utils.meters import get_summary_writer
+
+        return get_summary_writer()
+
+    def emit(self, event):
+        if event.get("kind") != "counter":
+            return
+        writer = self._resolve()
+        if writer is None:
+            return
+        try:
+            writer.add_scalar(event["name"], event["value"],
+                              event.get("step") or 0)
+        except Exception as e:  # noqa: BLE001 — never kill a run to log
+            logger.warning("TensorBoardSink dropped %s: %s",
+                           event.get("name"), e)
+
+    def flush(self):
+        writer = self._resolve()
+        if writer is not None and hasattr(writer, "flush"):
+            writer.flush()
+
+
+class ConsoleSink(Sink):
+    """Print the latest counter values as one line per flush."""
+
+    def __init__(self, print_fn=None):
+        self._latest = {}
+        self._print = print_fn or (lambda msg: logger.info(msg))
+
+    def emit(self, event):
+        if event.get("kind") == "counter":
+            self._latest[event["name"]] = (event["value"],
+                                           event.get("step"))
+
+    def flush(self):
+        if not self._latest:
+            return
+        step = max((s for _, s in self._latest.values()
+                    if s is not None), default=None)
+        parts = [f"{name}={value:.4g}" for name, (value, _)
+                 in sorted(self._latest.items())]
+        prefix = f"telemetry step={step}: " if step is not None \
+            else "telemetry: "
+        self._print(prefix + " ".join(parts))
+        self._latest = {}
+
+
+def make_sinks(names, logdir=None):
+    """Build the sink list named by the ``telemetry.sinks`` knob.
+
+    Unknown names warn and are skipped (a config typo should not kill a
+    training run). On multi-process runs the JSONL path is suffixed per
+    process so hosts never clobber each other's event streams; console
+    output stays master-only.
+    """
+    sinks = []
+    for name in names or ():
+        name = str(name).lower()
+        if name == "jsonl":
+            path = os.path.join(logdir or ".", "telemetry.jsonl")
+            try:
+                import jax
+
+                if jax.process_count() > 1:
+                    path += f".p{jax.process_index()}"
+            except Exception:  # noqa: BLE001 — backend not up yet
+                pass
+            sinks.append(JsonlSink(path))
+        elif name == "tensorboard":
+            sinks.append(TensorBoardSink())
+        elif name == "console":
+            try:
+                from imaginaire_tpu.parallel.mesh import is_master
+
+                if not is_master():
+                    continue
+            except Exception:  # noqa: BLE001
+                pass
+            sinks.append(ConsoleSink())
+        else:
+            logger.warning("unknown telemetry sink %r skipped "
+                           "(supported: jsonl, tensorboard, console)", name)
+    return sinks

@@ -1,0 +1,47 @@
+"""Hang watchdog: a daemon thread that dumps every Python thread's
+stack when no step heartbeat lands within ``telemetry.hang_timeout_s``.
+
+The failure mode this exists for: a production run that stops making
+progress emits *nothing* — a blocked prefetcher producer, a wedged
+checkpoint commit, and a 20-minute XLA compile all look identical from
+the outside. The dump (``Telemetry.dump_stacks``) shows exactly which
+thread is parked where: the ``device-prefetch`` producer blocked in
+``next(source)``, the ``ckpt-pointer`` thread inside
+``wait_until_finished``, or the main thread inside a jit compile.
+
+Fires at most once per stall: after a dump the watchdog re-arms only
+when a fresh heartbeat arrives, so a long hang produces one dump, not a
+dump per poll interval.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class HangWatchdog(threading.Thread):
+    def __init__(self, telemetry, timeout_s, poll_s=None):
+        super().__init__(daemon=True, name="telemetry-watchdog")
+        self._tm = telemetry
+        self.timeout_s = float(timeout_s)
+        self.poll_s = poll_s if poll_s is not None \
+            else max(min(self.timeout_s / 4.0, 1.0), 0.01)
+        self._stop_event = threading.Event()
+
+    def run(self):
+        fired = False
+        while not self._stop_event.wait(self.poll_s):
+            stall = self._tm._clock() - self._tm.last_heartbeat
+            if stall >= self.timeout_s:
+                if not fired:
+                    fired = True
+                    self._tm.dump_stacks(
+                        f"no step completed in {stall:.1f}s "
+                        f"(hang_timeout_s={self.timeout_s:g}); either the "
+                        "input pipeline, a checkpoint commit, or a "
+                        "compile is stuck — see per-thread stacks")
+            else:
+                fired = False
+
+    def stop(self):
+        self._stop_event.set()

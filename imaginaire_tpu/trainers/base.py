@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from imaginaire_tpu import telemetry
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.optim import get_optimizer_for_params, get_scheduler
 from imaginaire_tpu.parallel.mesh import is_master, master_only_print as print  # noqa: A001
@@ -99,6 +100,7 @@ class BaseTrainer:
         self.meters: Dict[str, Meter] = {}
         self.time_iteration = None
         self.time_epoch = None
+        self._step_flops_probed = False
         self._jit_gen_step = jax.jit(self._gen_step_fn, donate_argnums=0)
         self._jit_dis_step = jax.jit(self._dis_step_fn, donate_argnums=0)
 
@@ -271,7 +273,9 @@ class BaseTrainer:
         t0 = time.time() if self.speed_benchmark else None
         from imaginaire_tpu.utils.misc import numeric_only
 
-        self.state, losses = self._jit_gen_step(self.state, numeric_only(data))
+        with telemetry.span("gen_step", step=self.current_iteration):
+            self.state, losses = self._jit_gen_step(self.state,
+                                                    numeric_only(data))
         if self.speed_benchmark:
             jax.block_until_ready(self.state["vars_G"]["params"])
             self._meter("time/gen_step").write(time.time() - t0)
@@ -285,7 +289,9 @@ class BaseTrainer:
         t0 = time.time() if self.speed_benchmark else None
         from imaginaire_tpu.utils.misc import numeric_only
 
-        self.state, losses = self._jit_dis_step(self.state, numeric_only(data))
+        with telemetry.span("dis_step", step=self.current_iteration):
+            self.state, losses = self._jit_dis_step(self.state,
+                                                    numeric_only(data))
         if self.speed_benchmark:
             jax.block_until_ready(self.state["vars_D"]["params"])
             self._meter("time/dis_step").write(time.time() - t0)
@@ -300,20 +306,26 @@ class BaseTrainer:
     def start_of_iteration(self, data, current_iteration):
         from imaginaire_tpu.data.device_prefetch import PrefetchedBatch
 
-        prefetched = isinstance(data, PrefetchedBatch)
-        if not prefetched:
-            data = self._start_of_iteration(data, current_iteration)
-        self.current_iteration = current_iteration
-        self.start_iteration_time = time.time()
-        self._maybe_profile(current_iteration)
-        if prefetched:
-            # a DevicePrefetcher already ran the host hook and committed
-            # the numeric leaves as sharded device arrays — re-running
-            # either would drag them back through the host
-            return data
-        from imaginaire_tpu.utils.misc import to_device
+        # the data_wait span covers the host hook + H2D transfer (the
+        # per-step input cost this process pays; the feed wait itself is
+        # a sibling span in the train loop). Near-zero for prefetched
+        # batches — exactly what the phase table should show.
+        with telemetry.span("data_wait", step=current_iteration):
+            prefetched = isinstance(data, PrefetchedBatch)
+            if not prefetched:
+                data = self._start_of_iteration(data, current_iteration)
+            self.current_iteration = current_iteration
+            self.start_iteration_time = time.time()
+            self._maybe_profile(current_iteration)
+            if prefetched:
+                # a DevicePrefetcher already ran the host hook and
+                # committed the numeric leaves as sharded device arrays
+                # — re-running either would drag them back through the
+                # host
+                return data
+            from imaginaire_tpu.utils.misc import to_device
 
-        return to_device(data)
+            return to_device(data)
 
     def data_prefetcher(self, loader, iteration_of=None):
         """Wrap ``loader`` in a DevicePrefetcher honoring the
@@ -392,6 +404,15 @@ class BaseTrainer:
         self.current_iteration = current_iteration
         self._end_of_iteration(data, current_epoch, current_iteration)
         self.time_iteration = time.time() - self.start_iteration_time
+        tm = telemetry.get()
+        if tm.enabled:
+            self._register_step_flops(data)
+            # heartbeat + ring-buffer accounting; the fence only runs at
+            # the flush interval (never a per-step device sync)
+            tm.step_complete(
+                current_iteration, items=self._batch_items(data),
+                dur_s=self.time_iteration,
+                fence=lambda: jax.block_until_ready(self.state))
         cfg = self.cfg
         if current_iteration % cfg_get(cfg, "logging_iter", 100) == 0:
             self._meter("time/iteration").write(self.time_iteration)
@@ -414,6 +435,64 @@ class BaseTrainer:
         if current_epoch % cfg_get(self.cfg, "snapshot_save_epoch", 20) == 0:
             self.save_checkpoint(current_epoch, current_iteration)
             self.write_metrics()
+
+    @staticmethod
+    def _batch_items(data):
+        """Samples in a batch (imgs/sec accounting): leading dim of the
+        first array leaf; video batches count frames (B*T)."""
+        try:
+            leaves = [v for v in (data or {}).values()
+                      if hasattr(v, "shape") and getattr(v, "ndim", 0) >= 1]
+            if not leaves:
+                return 0
+            lead = leaves[0]
+            if getattr(lead, "ndim", 0) >= 5:  # (B, T, H, W, C)
+                return int(lead.shape[0]) * int(lead.shape[1])
+            return int(lead.shape[0])
+        except Exception:  # noqa: BLE001 — accounting must never raise
+            return 0
+
+    def _register_step_flops(self, data):
+        """Register per-iteration FLOPs with telemetry ONCE, at jit
+        time, via XLA cost analysis of the two step programs — the
+        ``scripts/perf_lab.py`` method (``lowered.compile()
+        .cost_analysis()['flops']``), weighted by the dis_step/gen_step
+        multipliers. Guarded by ``telemetry.mfu``; failures degrade to a
+        debug log (MFU simply stays absent). Trainers whose update is
+        not the base two-program step (vid2vid's per-frame rollout)
+        override this to a no-op."""
+        tm = telemetry.get()
+        if self._step_flops_probed or not (tm.enabled and tm.wants_mfu) \
+                or tm.step_flops is not None:
+            return
+        self._step_flops_probed = True
+        from imaginaire_tpu.utils.misc import numeric_only
+
+        batch = numeric_only(data)
+        programs = [(self._jit_gen_step,
+                     cfg_get(self.cfg.trainer, "gen_step", 1))]
+        if self.net_D is not None:
+            programs.append((self._jit_dis_step,
+                             cfg_get(self.cfg.trainer, "dis_step", 1)))
+        total = 0.0
+        try:
+            with telemetry.span("cost_analysis"):
+                for fn, mult in programs:
+                    cost = fn.lower(self.state, batch).compile() \
+                        .cost_analysis()
+                    if isinstance(cost, list):
+                        cost = cost[0]
+                    flops = cost.get("flops")
+                    if flops is None or not math.isfinite(float(flops)):
+                        return
+                    total += float(flops) * mult
+        except Exception as e:  # noqa: BLE001 — MFU is best-effort
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "step cost analysis unavailable: %s", e)
+            return
+        tm.set_step_flops(total)
 
     def _write_weight_stats(self, step):
         """Spectral-norm σ/weight-norm stats per logging interval
@@ -512,7 +591,8 @@ class BaseTrainer:
         except FileNotFoundError as e:
             print(f"extra metrics skipped: {e}")
             return out
-        acts = self._extra_metric_activations(extractor)
+        with telemetry.span("eval", step=self.current_iteration):
+            acts = self._extra_metric_activations(extractor)
         if acts is None:
             return out
         act_real, act_fake = acts
@@ -532,7 +612,9 @@ class BaseTrainer:
 
     def write_metrics(self):
         """FID + best-FID tracking (ref: base.py:467-479)."""
-        fid = self._compute_fid()
+        with telemetry.span("eval", step=self.current_iteration):
+            fid = self._compute_fid()
+        telemetry.get().heartbeat(self.current_iteration)
         if fid is not None:
             if getattr(self, "best_fid", None) is None or fid < self.best_fid:
                 self.best_fid = fid
@@ -690,12 +772,15 @@ class BaseTrainer:
         # overlap the next batch's host load + H2D with this batch's
         # generate (start_of_iteration skips re-prep for wrapped batches)
         data_loader = self.data_prefetcher(data_loader)
-        for it, data in enumerate(data_loader):
+        tm = telemetry.get()
+        for it, data in enumerate(tm.timed_iter(data_loader, "data_wait")):
+            tm.heartbeat()
             data = self.start_of_iteration(data, current_iteration=-1)
-            images = self.net_G.apply(
-                variables, data, training=False,
-                rngs={"noise": jax.random.PRNGKey(it)},
-                method=self.net_G.inference, **inference_args)
+            with tm.span("eval"):
+                images = self.net_G.apply(
+                    variables, data, training=False,
+                    rngs={"noise": jax.random.PRNGKey(it)},
+                    method=self.net_G.inference, **inference_args)
             keys = data.get("key", [f"{it:06d}_{i}" for i in range(images.shape[0])])
             if isinstance(keys, (str, bytes)):
                 keys = [keys]

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from imaginaire_tpu import telemetry
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.losses import PerceptualLoss, feature_matching_loss, gan_loss
 from imaginaire_tpu.losses.flow import masked_l1_loss
@@ -558,6 +559,13 @@ class Trainer(BaseTrainer):
 
         With trainer.rollout_scan, frames past the ring-buffer warm-up
         run inside one lax.scan program (_rollout_tail_fn)."""
+        # the gen_step span covers the whole rollout (per-frame dis_step
+        # spans nest inside it — D updates happen here, dis_update is a
+        # no-op for this family)
+        with telemetry.span("gen_step", step=self.current_iteration):
+            return self._gen_update_rollout(data)
+
+    def _gen_update_rollout(self, data):
         data = numeric_only(data)
         seq_len = (data["images"].shape[1] if data["images"].ndim == 5
                    else 1)
@@ -583,8 +591,10 @@ class Trainer(BaseTrainer):
                 # boundary
                 data_jit = {k: v for k, v in data_t.items()
                             if not k.startswith("_")}
-                self.state, d_losses = self._jit_vid_dis(self.state,
-                                                         data_jit)
+                with telemetry.span("dis_step",
+                                    step=self.current_iteration):
+                    self.state, d_losses = self._jit_vid_dis(self.state,
+                                                             data_jit)
                 self.state, g_losses, fake = self._jit_vid_gen(self.state,
                                                                data_jit)
                 d_hist.append(d_losses)
@@ -859,6 +869,13 @@ class Trainer(BaseTrainer):
     def dis_update(self, data):
         """D updates happen inside gen_update's rollout
         (ref: trainers/vid2vid.py:290-296)."""
+        return None
+
+    def _register_step_flops(self, data):
+        """No-op: the video families step through per-frame programs
+        (+ an optional scan tail), not the base two-program step —
+        lowering those unused programs here would trigger pointless
+        compiles. MFU for this family comes from scripts/perf_lab.py."""
         return None
 
     # ----------------------------------------------------------- curriculum

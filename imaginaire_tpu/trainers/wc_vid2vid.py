@@ -15,6 +15,7 @@ import re
 
 import numpy as np
 
+from imaginaire_tpu import telemetry
 from imaginaire_tpu.config import cfg_get
 from imaginaire_tpu.model_utils.wc_vid2vid import (
     SplatRenderer,
@@ -300,16 +301,20 @@ class Trainer(Vid2VidTrainer):
         data_t = super()._get_data_t(data, t, prev_labels, prev_images)
         label = data_t["label"]
         b, h, w, _ = label.shape
-        guidance = []
-        infos = [self._point_info(data, t, bi, target_hw=(h, w))
-                 for bi in range(b)]
-        for bi, info in enumerate(infos):
-            if info is not None:
-                guidance.append(guidance_tensor(
-                    self._renderer(bi), info, w, h,
-                    flipped=self.is_flipped_input))
-            else:
-                guidance.append(np.zeros((h, w, 4), np.float32))
+        # host-side point-cloud projection runs inside the rollout's
+        # gen_step span — give it its own phase so the telemetry table
+        # separates CPU guidance rendering from XLA dispatch
+        with telemetry.span("wc_guidance", step=self.current_iteration):
+            guidance = []
+            infos = [self._point_info(data, t, bi, target_hw=(h, w))
+                     for bi in range(b)]
+            for bi, info in enumerate(infos):
+                if info is not None:
+                    guidance.append(guidance_tensor(
+                        self._renderer(bi), info, w, h,
+                        flipped=self.is_flipped_input))
+                else:
+                    guidance.append(np.zeros((h, w, 4), np.float32))
         if any(info is not None for info in infos):
             data_t["guidance"] = np.stack(guidance)
             data_t["_point_infos"] = infos

@@ -32,6 +32,7 @@ import re
 
 import orbax.checkpoint as ocp
 
+from imaginaire_tpu import telemetry
 from imaginaire_tpu.parallel.mesh import is_master
 
 _POINTER = "latest_checkpoint.txt"
@@ -99,7 +100,11 @@ def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None,
     if async_save:
         global _POINTER_THREAD
         ckpt = _async_checkpointer()
-        ckpt.save(path, state)
+        with telemetry.span("ckpt"):
+            # async path: the span covers only the device snapshot +
+            # save dispatch (what the step loop actually pays); the
+            # background commit gets its own ckpt_commit span
+            ckpt.save(path, state)
         # orbax finalizes the save (tmp-dir rename) on its background
         # thread; queue the pointer write behind that commit so readers
         # never observe pointer-before-commit. The thread handle is kept
@@ -114,20 +119,24 @@ def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None,
 
         def _commit_then_point():
             try:
-                ckpt.wait_until_finished()
+                with telemetry.span("ckpt_commit"):
+                    ckpt.wait_until_finished()
                 _write_pointer()
             except BaseException as e:  # re-raised by the joiner
                 _commit_then_point.error = e
 
         _commit_then_point.error = None
+        # named so watchdog stack dumps identify a wedged commit
         _POINTER_THREAD = threading.Thread(target=_commit_then_point,
-                                           daemon=True)
+                                           daemon=True, name="ckpt-pointer")
         _POINTER_THREAD._pointer_fn = _commit_then_point
         _POINTER_THREAD.start()
     else:
-        with ocp.PyTreeCheckpointer() as ckpt:
-            ckpt.save(path, state)
+        with telemetry.span("ckpt"):
+            with ocp.PyTreeCheckpointer() as ckpt:
+                ckpt.save(path, state)
         _write_pointer()
+        telemetry.get().heartbeat()
     return path
 
 
@@ -136,7 +145,9 @@ def wait_for_pending_checkpoint():
     pointer write has landed."""
     global _POINTER_THREAD
     if _ASYNC_CKPT is not None:
-        _ASYNC_CKPT.wait_until_finished()
+        with telemetry.span("ckpt_wait"):
+            _ASYNC_CKPT.wait_until_finished()
+        telemetry.get().heartbeat()
     if _POINTER_THREAD is not None:
         thread = _POINTER_THREAD
         _POINTER_THREAD = None
@@ -170,7 +181,7 @@ def load_checkpoint(path, target=None):
     """
     import jax
 
-    with ocp.PyTreeCheckpointer() as ckpt:
+    with telemetry.span("ckpt_load"), ocp.PyTreeCheckpointer() as ckpt:
         if target is not None:
             return ckpt.restore(os.path.abspath(path),
                                 item=jax.device_get(target))

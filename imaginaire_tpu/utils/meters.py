@@ -8,19 +8,31 @@ like every reference writer.
 
 from __future__ import annotations
 
+import logging
 import math
 
 from imaginaire_tpu.parallel.mesh import is_master, master_only
+
+logger = logging.getLogger(__name__)
 
 _WRITER = None
 
 
 @master_only
 def set_summary_writer(log_dir):
-    """(ref: meters.py:55-60)."""
+    """(ref: meters.py:55-60). A missing torch degrades to a logged
+    warning + no writer: scalar history still lands in the telemetry
+    sinks (telemetry/sinks.py), so torch-free hosts train fine."""
     global _WRITER
-    from torch.utils.tensorboard import SummaryWriter
-
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+    except ImportError as e:
+        logger.warning(
+            "torch.utils.tensorboard unavailable (%s); TensorBoard "
+            "summaries disabled — scalars still flow to the telemetry "
+            "sinks (telemetry.jsonl)", e)
+        _WRITER = None
+        return
     _WRITER = SummaryWriter(log_dir=log_dir)
 
 
@@ -50,12 +62,18 @@ def add_hparams(hparam_dict=None, metric_dict=None):
 
 @master_only
 def write_summary(name, data, step, hist=False):
-    """(ref: meters.py:63-78)."""
-    if _WRITER is None:
-        return
+    """(ref: meters.py:63-78). Scalars fan out through the telemetry
+    sinks (jsonl/console/tensorboard); when a TensorBoardSink is
+    configured it owns the TB write, otherwise the direct writer path
+    keeps the original behavior bit-for-bit."""
     if hist:
-        _WRITER.add_histogram(name, data, step)
-    else:
+        if _WRITER is not None:
+            _WRITER.add_histogram(name, data, step)
+        return
+    from imaginaire_tpu import telemetry
+
+    tb_handled = telemetry.get().counter(name, float(data), step=step)
+    if not tb_handled and _WRITER is not None:
         _WRITER.add_scalar(name, data, step)
 
 
@@ -87,8 +105,13 @@ class Meter:
     def flush(self, step):
         values = [float(v) for v in self.values]  # device sync happens here
         finite = [v for v in values if math.isfinite(v)]
-        if len(finite) != len(values):
-            print(f"meter {self.name} has non-finite values")
+        dropped = len(values) - len(finite)
+        if dropped:
+            # a nonfinite_count scalar makes NaN onset visible on
+            # dashboards instead of only in scrollback
+            logger.warning("meter %s has %d non-finite value(s) at step "
+                           "%s", self.name, dropped, step)
+            write_summary(f"{self.name}/nonfinite_count", dropped, step)
         if finite:
             write_summary(self.name, sum(finite) / len(finite), step)
         self.reset()

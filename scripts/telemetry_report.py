@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Render a run's telemetry.jsonl into the PROFILE.md-style per-phase
+attribution table (counts, totals, p50/p99, share of wall) plus the
+derived counters (imgs/sec, MFU, step percentiles) and hang dumps.
+
+Usage:
+    python scripts/telemetry_report.py logs/<run>/telemetry.jsonl
+    python scripts/telemetry_report.py logs/<run>            # dir works too
+    python scripts/telemetry_report.py <path> --json         # machine-readable
+
+The MFU shown is reproducible from the JSONL alone: the ``step_flops``
+meta event records the XLA cost analysis (and the peak-FLOPs source),
+and ``perf/mfu`` counters record flops*steps / (fenced-window-wall *
+peak) at each flush.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from imaginaire_tpu.telemetry.report import (  # noqa: E402
+    load_events,
+    render_report,
+    summarize,
+)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Per-phase report from a telemetry.jsonl")
+    ap.add_argument("path", help="telemetry.jsonl (or a run dir "
+                                 "containing one)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the aggregated summary as JSON instead "
+                         "of the table")
+    args = ap.parse_args()
+    path = args.path
+    if os.path.isdir(path):
+        path = os.path.join(path, "telemetry.jsonl")
+    if not os.path.exists(path):
+        raise SystemExit(f"no telemetry.jsonl at {path}")
+    if args.json:
+        summary = summarize(load_events(path))
+        summary["counters"] = {k: {"value": v, "step": s}
+                               for k, (v, s) in summary["counters"].items()}
+        print(json.dumps(summary, indent=1, default=str))
+    else:
+        print(render_report(path))
+
+
+if __name__ == "__main__":
+    main()

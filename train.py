@@ -11,6 +11,7 @@ import os
 
 import jax
 
+from imaginaire_tpu import telemetry
 from imaginaire_tpu.config import Config, cfg_get
 from imaginaire_tpu.data import get_train_and_val_dataloader
 from imaginaire_tpu.parallel.mesh import create_mesh, master_only_print as print, set_mesh, honor_platform_env
@@ -40,6 +41,10 @@ def main():
     date_uid, logdir = init_logging(args.config, args.logdir)
     make_logging_dir(logdir)
     cfg.logdir = logdir
+    # structured run telemetry (telemetry/): spans + counters fan out to
+    # the configured sinks (<logdir>/telemetry.jsonl by default); the
+    # watchdog/trace knobs ride the same cfg section
+    tm = telemetry.configure(cfg, logdir=logdir)
 
     train_loader, val_loader = get_train_and_val_dataloader(cfg, seed=args.seed)
     trainer_cls = resolve(cfg.trainer.type, "Trainer")
@@ -92,7 +97,13 @@ def main():
         train_loader.set_epoch(epoch)
         trainer.start_of_epoch(epoch)
         epoch_base[0] = current_iteration
-        for it, data in enumerate(feed):
+        # each next(feed) is timed as a data_wait span: with the
+        # prefetcher healthy it is ~0; a starved queue shows up as the
+        # dominant phase in the telemetry table instead of vanishing
+        # into "slow steps"
+        timed_feed = tm.timed_iter(
+            feed, "data_wait", step_of=lambda index: epoch_base[0] + index)
+        for it, data in enumerate(timed_feed):
             data = trainer.start_of_iteration(data, current_iteration)
             for _ in range(dis_steps):
                 trainer.dis_update(data)
@@ -105,18 +116,20 @@ def main():
             if current_iteration >= max_iter:
                 print("Done with training!!!")
                 trainer.save_checkpoint(epoch, current_iteration)
-                _drain_checkpoints()
+                _finalize_run()
                 return
         trainer.end_of_epoch(data, epoch, current_iteration)
     print("Done with training!!!")
-    _drain_checkpoints()
+    _finalize_run()
 
 
-def _drain_checkpoints():
-    """Async checkpoint saves must commit before the process exits."""
+def _finalize_run():
+    """Async checkpoint saves must commit — and telemetry must flush its
+    final window — before the process exits."""
     from imaginaire_tpu.utils.checkpoint import wait_for_pending_checkpoint
 
     wait_for_pending_checkpoint()
+    telemetry.get().shutdown()
 
 
 if __name__ == "__main__":
