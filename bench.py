@@ -307,6 +307,64 @@ def run_vid2vid(seq_len=4):
                      f"{last_error}")
 
 
+def run_diag_ab(width="unit", iters=10):
+    """Diagnostics-overhead A/B (ISSUE 3 acceptance): the same SPADE
+    training loop with training-health auditing on (the shipping
+    default: every_n_steps=10, in-graph non-finite guard + finite-flag
+    poll) vs fully off. Prints one JSON line with the overhead pct and
+    records both raw rates in DIAGBENCH.json. Separate trainers per arm:
+    the step *programs* differ (the audit is traced in), so this is the
+    honest comparison — program + host-side monitor cost together."""
+    import jax
+    import jax.numpy as jnp
+
+    build = build_unit if width == "unit" else build_zoo
+    rates = {}
+    for arm, enabled in (("diag_on", True), ("diag_off", False)):
+        jax.clear_caches()
+        trainer, label_ch = build()
+        trainer.cfg.diagnostics.enabled = enabled
+        from imaginaire_tpu.diagnostics import HealthMonitor
+
+        trainer.diag = HealthMonitor(trainer.cfg)
+        bs = 8
+        data = jax.device_put(
+            jax.tree_util.tree_map(np.asarray, batch_of(bs, label_ch)))
+        jax.block_until_ready(data)
+        trainer.init_state(jax.random.PRNGKey(0), data)
+
+        def sync():
+            leaf = jax.tree_util.tree_leaves(
+                trainer.state["vars_G"]["params"])[0]
+            return float(jnp.sum(leaf))
+
+        for _ in range(2):
+            trainer.dis_update(data)
+            trainer.gen_update(data)
+        sync()
+        t0 = time.time()
+        for _ in range(iters):
+            trainer.dis_update(data)
+            trainer.gen_update(data)
+        sync()
+        rates[arm] = bs * iters / (time.time() - t0)
+        trainer.state = None
+    overhead_pct = (rates["diag_off"] / rates["diag_on"] - 1.0) * 100.0
+    payload = {
+        "metric": f"spade_diagnostics_overhead_pct_{width}",
+        "value": round(overhead_pct, 2),
+        "unit": "pct",
+        "vs_baseline": None,
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "DIAGBENCH.json"), "w") as f:
+        json.dump(dict(payload,
+                       imgs_per_sec_diag_on=round(rates["diag_on"], 3),
+                       imgs_per_sec_diag_off=round(rates["diag_off"], 3),
+                       every_n_steps=10, iters=iters), f, indent=1)
+    print(json.dumps(payload))
+
+
 def batch_of(bs, label_ch):
     # int label map, one-hot expanded on device inside the jitted step —
     # ships ~KB/img to the chip instead of ~48MB of one-hot floats.
@@ -803,7 +861,14 @@ def main():
                              "(VIDBENCH.json); pix2pixHD/munit/"
                              "fs_vid2vid = remaining BASELINE-tracked "
                              "families (FAMILYBENCH.json)")
+    parser.add_argument("--diag-ab", action="store_true",
+                        help="measure the training-health diagnostics "
+                             "overhead (on vs off) on the SPADE step "
+                             "at --width and record DIAGBENCH.json")
     args = parser.parse_args()
+    if args.diag_ab:
+        run_diag_ab(width=args.width)
+        return
     if args.data == "packed":
         if args.model != "spade":
             raise SystemExit("--data packed is the SPADE pipeline leg")

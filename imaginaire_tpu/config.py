@@ -165,6 +165,11 @@ def default_config():
             loss_weight=AttrDict(),
             init=AttrDict(type="xavier", gain=0.02),
             grad_clip_norm=None,
+            # donate the train-state buffers to the jitted steps (the
+            # memory-optimal default); train.py --debug-nans turns this
+            # off, since jax_debug_nans re-runs ops against buffers
+            # donation already invalidated
+            donate_step_buffers=True,
         ),
         gen=AttrDict(type="imaginaire_tpu.models.generators.dummy"),
         dis=AttrDict(type="imaginaire_tpu.models.discriminators.dummy"),
@@ -220,6 +225,31 @@ def default_config():
             trace_num_steps=5,
             mfu=True,  # one-time XLA cost analysis of the step programs
             peak_flops=None,  # None => per-device-kind table (v5e default)
+            # spans that suspend the hang watchdog while open (long
+            # FID/KID eval sweeps complete no training steps by design)
+            watchdog_exempt_spans=["eval"],
+        ),
+        # -- training-health diagnostics (diagnostics/): in-step norm
+        # auditing (per-module grad/param norms, update/param ratio,
+        # spectral-norm sigma, EMA drift) computed INSIDE the jitted D/G
+        # step programs every `every_n_steps` (lax.cond — zero extra
+        # recompiles, donation-safe), GAN balance metrics (D real/fake
+        # accuracy, D/G loss-ratio EWMA with warning thresholds), and
+        # non-finite provenance triage: a non-finite update never lands
+        # (in-graph guard), the culprit loss term / module is localized
+        # by a one-shot eager pass, and logs/<run>/nonfinite_report.json
+        # records the provenance. on_nonfinite: halt | skip | rollback
+        # (rollback restores the last audited-finite device snapshot —
+        # costs one extra state-sized buffer).
+        diagnostics=AttrDict(
+            enabled=True,
+            every_n_steps=10,
+            on_nonfinite="halt",
+            history=64,  # health ring buffer (last-K context in reports)
+            dg_ratio_beta=0.9,  # D/G loss-ratio EWMA smoothing
+            dg_ratio_warn_low=0.1,
+            dg_ratio_warn_high=10.0,
+            max_triage_terms=16,  # cap on the per-term grad triage pass
         ),
         # -- TPU runtime (replaces ref cudnn/local_rank blocks, config.py:143-150)
         runtime=AttrDict(

@@ -1,0 +1,41 @@
+"""Training-health diagnostics (ISSUE 3): in-step gradient/update
+auditing, GAN balance metrics, and non-finite provenance triage.
+
+Three pillars, all riding the PR 2 telemetry sinks:
+
+- **norm auditing** (``audit.py``) — per-top-level-module gradient norm,
+  parameter norm and update/param ratio for G and D, EMA drift, and
+  spectral-norm sigma tracking, computed *inside* the jitted step
+  programs at ``diagnostics.every_n_steps`` cadence via ``lax.cond`` so
+  the step programs stay donation-safe and recompile-free (the health
+  summary is a fixed-size pytree of fp32 scalars).
+- **GAN balance** (``monitor.py``) — per-loss-term breakdown (the loss
+  registry already itemizes terms), discriminator real/fake accuracy
+  (``losses.gan.dis_accuracy``), and a D/G loss-ratio EWMA with
+  configurable warning thresholds surfaced as telemetry counters.
+- **non-finite provenance triage** (``triage.py``) — a per-step finite
+  flag is computed in-graph and polled with one-step lag (the previous
+  program has finished by then, so the poll never stalls dispatch).
+  When a loss or grad goes non-finite, a one-shot eager triage pass
+  re-evaluates each loss term and each module's grad norm separately,
+  dumps ``logs/<run>/nonfinite_report.json``, and halts / skips /
+  rolls back per ``diagnostics.on_nonfinite``. With diagnostics enabled
+  the step programs additionally *guard* the update in-graph: a
+  non-finite update never lands (params/opt/mutables keep their previous
+  finite values), so "skip" recovery is exact and triage always sees
+  uncorrupted parameters.
+"""
+
+from imaginaire_tpu.diagnostics.monitor import (  # noqa: F401
+    HealthMonitor,
+    NonFiniteLossError,
+    diagnostics_settings,
+)
+from imaginaire_tpu.diagnostics import audit  # noqa: F401
+
+__all__ = [
+    "HealthMonitor",
+    "NonFiniteLossError",
+    "diagnostics_settings",
+    "audit",
+]

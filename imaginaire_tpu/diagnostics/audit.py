@@ -1,0 +1,156 @@
+"""In-jit health auditing primitives.
+
+Everything here is traced into the D/G step programs. Two design
+constraints drive the shapes:
+
+- **recompile-free**: the health summary is a flat ``{str: f32 scalar}``
+  dict whose key set depends only on the (static) parameter structure,
+  and the cadence gate is a ``lax.cond`` on the traced step counter —
+  one program covers both the audited and the skipped step, so
+  ``diagnostics.every_n_steps`` never retraces.
+- **donation-safe**: the non-finite guard (``select_finite``) reads the
+  donated input buffers and selects between old and new values; XLA
+  aliases the output onto the donated input either way, so guarded steps
+  cost one fused select pass over the updated trees, not extra memory.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def tree_norm(tree):
+    """Global L2 norm of a pytree (optax.global_norm, fp32)."""
+    return optax.global_norm(tree).astype(jnp.float32)
+
+
+def finite_flag(total_loss, grad_norm):
+    """Bool scalar: this step's loss AND gradients are finite. A single
+    NaN/Inf anywhere in the grads poisons the global norm, so one
+    reduction covers the whole tree."""
+    return jnp.isfinite(total_loss) & jnp.isfinite(grad_norm)
+
+
+def select_finite(ok, new, old):
+    """Elementwise ``new if ok else old`` over matching pytrees — the
+    in-graph non-finite update guard."""
+    return jax.tree_util.tree_map(lambda n, o: jnp.where(ok, n, o),
+                                  new, old)
+
+
+# ------------------------------------------------------------- sigmas
+
+def estimate_sigma_list(params, spectral, eps=1e-12):
+    """Read-only spectral-norm sigma estimates ``u^T W v`` for every
+    spectrally-normalized kernel (same matrix view as
+    ``layers/weight_norm.py``; the stored power-iteration ``u`` is NOT
+    advanced). Returns a list of scalars in a deterministic walk order.
+    """
+    from imaginaire_tpu.layers.weight_norm import estimate_sigma
+
+    sigmas = []
+
+    def walk(spec, par):
+        if not isinstance(spec, Mapping):
+            return
+        u = spec.get("u")
+        if u is not None and not isinstance(u, Mapping):
+            kernel = par.get("kernel") if isinstance(par, Mapping) else None
+            if kernel is not None:
+                sigmas.append(estimate_sigma(kernel, u, eps=eps))
+        for key in sorted(spec):
+            child = spec[key]
+            if isinstance(child, Mapping):
+                walk(child,
+                     par.get(key, {}) if isinstance(par, Mapping) else {})
+
+    walk(spectral or {}, params or {})
+    return sigmas
+
+
+# ------------------------------------------------------- health summary
+
+def _module_items(tree):
+    """Deterministic (name, subtree) pairs for the top-level modules of
+    a params dict; non-Mapping leaves at the root get their own entry."""
+    if not isinstance(tree, Mapping):
+        return [("_root", tree)]
+    return [(str(k), tree[k]) for k in sorted(tree, key=str)]
+
+
+def health_keys(params, spectral=None, ema=None):
+    """The static key set ``module_health`` will emit for these trees —
+    used to build the zero-filled off-cadence branch of the cond."""
+    keys = []
+    for stat in ("grad_norm", "param_norm", "update_ratio"):
+        keys.append(f"{stat}/_total")
+        keys.extend(f"{stat}/{name}" for name, _ in _module_items(params))
+    if spectral is not None and jax.tree_util.tree_leaves(spectral):
+        keys.extend(("sn_sigma/mean", "sn_sigma/max"))
+    if ema is not None:
+        keys.append("ema_drift")
+    return keys
+
+
+def module_health(grads, params, updates, spectral=None, ema=None,
+                  grad_norm_total=None, eps=1e-12):
+    """The fixed-size health summary: per-top-level-module gradient
+    norm, parameter norm and update/param ratio, plus spectral-sigma
+    stats and EMA drift when those trees exist.
+
+    ``ema_drift`` is ``||ema - params|| / ||params||``; with
+    ``model_average_remove_sn`` the EMA copy stores sigma-collapsed
+    kernels, so the drift carries a constant SN-collapse offset — the
+    *trend* is the signal, not the absolute level.
+    """
+    h = {}
+    pnorm_total = tree_norm(params)
+    h["grad_norm/_total"] = (grad_norm_total if grad_norm_total is not None
+                             else tree_norm(grads))
+    h["param_norm/_total"] = pnorm_total
+    h["update_ratio/_total"] = tree_norm(updates) / (pnorm_total + eps)
+    grads_m = dict(_module_items(grads))
+    updates_m = dict(_module_items(updates))
+    for name, sub_p in _module_items(params):
+        pn = tree_norm(sub_p)
+        h[f"grad_norm/{name}"] = tree_norm(grads_m.get(name, ()))
+        h[f"param_norm/{name}"] = pn
+        h[f"update_ratio/{name}"] = \
+            tree_norm(updates_m.get(name, ())) / (pn + eps)
+    if spectral is not None and jax.tree_util.tree_leaves(spectral):
+        sigmas = estimate_sigma_list(params, spectral, eps=eps)
+        if sigmas:
+            stack = jnp.stack([s.astype(jnp.float32) for s in sigmas])
+            h["sn_sigma/mean"] = jnp.mean(stack)
+            h["sn_sigma/max"] = jnp.max(stack)
+        else:  # spectral collection present but no kernel pairs resolved
+            h["sn_sigma/mean"] = jnp.zeros((), jnp.float32)
+            h["sn_sigma/max"] = jnp.zeros((), jnp.float32)
+    if ema is not None:
+        diff = jax.tree_util.tree_map(lambda e, p: e - p, ema, params)
+        h["ema_drift"] = tree_norm(diff) / (pnorm_total + eps)
+    return {k: v.astype(jnp.float32) for k, v in h.items()}
+
+
+def health_at_cadence(pred, grads, params, updates, spectral=None,
+                      ema=None, grad_norm_total=None):
+    """``module_health`` under ``lax.cond(pred, ...)``: off-cadence steps
+    return the same fixed-size dict filled with zeros, so the norm
+    reductions only execute when the audit is due and the program never
+    retraces on the cadence."""
+    keys = health_keys(params, spectral=spectral, ema=ema)
+
+    def full():
+        h = module_health(grads, params, updates, spectral=spectral,
+                          ema=ema, grad_norm_total=grad_norm_total)
+        assert sorted(h) == sorted(keys), (sorted(h), sorted(keys))
+        return {k: h[k] for k in keys}
+
+    def zeros():
+        return {k: jnp.zeros((), jnp.float32) for k in keys}
+
+    return jax.lax.cond(pred, full, zeros)

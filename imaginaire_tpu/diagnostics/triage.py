@@ -1,0 +1,203 @@
+"""Non-finite provenance triage: the one-shot eager pass that runs when
+a step's loss or gradients go non-finite.
+
+The jitted step only tells us *that* the fused update exploded; this
+pass re-runs the offending forward EAGERLY (no jit — a triage compile
+would cost minutes on TPU and could itself fail) with the exact batch
+and a reconstructed per-step RNG, and localizes the culprit:
+
+1. every loss term is re-evaluated separately — a NaN that originates in
+   the forward (a bad batch, an exploding activation) names its term
+   directly;
+2. the total's gradient is decomposed into per-top-level-module norms —
+   a NaN that only appears in the backward (sqrt-at-zero, overflow in a
+   VJP) names the module it enters through;
+3. when the terms all evaluate finite but grads are non-finite, each
+   registered term's gradient is re-derived separately (bounded by
+   ``diagnostics.max_triage_terms``) so backward-only NaNs still name
+   their term.
+
+The report also carries per-input batch statistics (min/max/mean/
+non-finite counts) and the last-K health summaries from the monitor's
+ring buffer, then lands at ``logs/<run>/nonfinite_report.json``.
+
+Faithfulness caveat: detection lags the bad step by one program, so the
+re-run uses the trainer's *current* params. With diagnostics enabled the
+step programs guard updates in-graph (a non-finite update never lands),
+so params are the last finite values — at most one additional finite
+update past the state the bad step saw.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import time
+
+import jax
+import numpy as np
+import optax
+
+logger = logging.getLogger(__name__)
+
+
+def _finite(x):
+    return isinstance(x, (int, float)) and math.isfinite(x)
+
+
+def _float(x):
+    try:
+        return float(jax.device_get(x))
+    except Exception:  # noqa: BLE001 — a fetch failure must not kill triage
+        return float("nan")
+
+
+def _triage_rng(trainer, entry):
+    """Reconstruct the per-step RNG key the bad program folded in
+    (``health['rng_step']`` recorded the pre-increment counter)."""
+    stream = trainer.state["rng_G" if entry["kind"] == "G" else "rng_D"]
+    rng_step = int(jax.device_get(entry["health"]["rng_step"]))
+    return jax.random.fold_in(stream, rng_step), rng_step
+
+
+def _eval_losses(trainer, kind, data, rng, params=None):
+    """Eagerly re-run gen_forward/dis_forward with the trainer's current
+    state (optionally overriding the updated net's params) and return
+    the raw loss dict (device scalars)."""
+    st = trainer.state
+    cd = trainer._to_compute_dtype
+    if kind == "D":
+        vars_D = dict(st["vars_D"],
+                      params=cd(params if params is not None
+                                else st["vars_D"]["params"]))
+        out = trainer.dis_forward(cd(st["vars_G"]), vars_D,
+                                  st["loss_params"], cd(data), rng)
+    else:
+        vars_G = dict(st["vars_G"],
+                      params=cd(params if params is not None
+                                else st["vars_G"]["params"]))
+        out = trainer.gen_forward(vars_G, cd(st.get("vars_D")),
+                                  st["loss_params"], cd(data), rng)
+    return out[0]  # (losses, new_mut[, extra]) across trainer families
+
+
+def _module_grad_norms(trainer, kind, data, rng, term=None):
+    """Per-top-level-module gradient norms of one term (or the weighted
+    total) — eager ``jax.grad``, float results."""
+    import jax.numpy as jnp
+
+    from imaginaire_tpu.diagnostics.audit import _module_items
+
+    pkey = "vars_G" if kind == "G" else "vars_D"
+    params0 = trainer.state[pkey]["params"]
+
+    def loss_fn(params):
+        losses = _eval_losses(trainer, kind, data, rng, params=params)
+        losses = {k: v.astype(jnp.float32) for k, v in losses.items()}
+        if term is not None:
+            return losses[term]
+        return trainer._total(losses)
+
+    grads = jax.grad(loss_fn)(params0)
+    out = {"_total": _float(optax.global_norm(grads))}
+    for name, sub in _module_items(grads):
+        out[name] = _float(optax.global_norm(sub))
+    return out
+
+
+def batch_stats(data):
+    """Per-input statistics: shape, dtype, min/max/mean over finite
+    values, and the non-finite element count — the "was it the data?"
+    column of the report."""
+    stats = {}
+    try:
+        flat = jax.tree_util.tree_flatten_with_path(data)[0]
+    except Exception:  # noqa: BLE001
+        return stats
+    for path, leaf in flat:
+        if not hasattr(leaf, "shape") or not hasattr(leaf, "dtype"):
+            continue
+        name = jax.tree_util.keystr(path)
+        try:
+            arr = np.asarray(jax.device_get(leaf))
+        except Exception:  # noqa: BLE001
+            continue
+        entry = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+        if arr.size and arr.dtype.kind in "fiu":
+            arrf = arr.astype(np.float64)
+            finite = np.isfinite(arrf)
+            n_bad = int(arrf.size - finite.sum())
+            entry["nonfinite"] = n_bad
+            if finite.any():
+                vals = arrf[finite]
+                entry.update(min=float(vals.min()), max=float(vals.max()),
+                             mean=float(vals.mean()))
+        stats[name] = entry
+    return stats
+
+
+def run_triage(trainer, monitor, entry):
+    """Build the provenance report dict for one non-finite step."""
+    kind, step, data = entry["kind"], entry["step"], entry["data"]
+    t0 = time.time()
+    rng, rng_step = _triage_rng(trainer, entry)
+
+    terms = {k: _float(v)
+             for k, v in _eval_losses(trainer, kind, data, rng).items()}
+    culprit_terms = sorted(k for k, v in terms.items() if not _finite(v))
+
+    module_norms = _module_grad_norms(trainer, kind, data, rng)
+    culprit_modules = sorted(k for k, v in module_norms.items()
+                             if k != "_total" and not _finite(v))
+
+    per_term_grads = {}
+    if not culprit_terms and not _finite(module_norms.get("_total")):
+        # forward finite, backward non-finite: re-derive each registered
+        # term's gradient separately to name the term it enters through
+        candidates = [t for t in terms if t in trainer.weights]
+        if len(candidates) <= monitor.max_triage_terms:
+            for term in candidates:
+                try:
+                    norms = _module_grad_norms(trainer, kind, data, rng,
+                                               term=term)
+                except Exception as e:  # noqa: BLE001
+                    norms = {"_error": str(e)}
+                per_term_grads[term] = norms
+                if any(not _finite(v) for v in norms.values()
+                       if isinstance(v, float)):
+                    culprit_terms.append(term)
+        else:
+            logger.warning(
+                "triage: %d loss terms exceed "
+                "diagnostics.max_triage_terms=%d; skipping the per-term "
+                "gradient pass", len(candidates), monitor.max_triage_terms)
+    culprit_terms = sorted(set(culprit_terms))
+
+    return {
+        "step": step,
+        "update": kind,
+        "rng_step": rng_step,
+        "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "on_nonfinite": monitor.on_nonfinite,
+        "loss_terms": terms,
+        "culprit_terms": culprit_terms,
+        "module_grad_norms": module_norms,
+        "culprit_modules": culprit_modules,
+        "per_term_grad_norms": per_term_grads or None,
+        "batch_stats": batch_stats(data),
+        "health_history": list(monitor.history),
+        "nonfinite_events": monitor.nonfinite_events,
+        "triage_duration_s": round(time.time() - t0, 3),
+    }
+
+
+def write_report(logdir, report):
+    """Dump the triage report as ``<logdir>/nonfinite_report.json``."""
+    path = os.path.join(logdir or ".", "nonfinite_report.json")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=1, default=str)
+    logger.error("non-finite triage report written to %s", path)
+    return path

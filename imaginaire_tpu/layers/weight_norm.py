@@ -38,6 +38,18 @@ def power_iteration(w_mat, u, n_steps=1, eps=1e-12):
     return sigma, u
 
 
+def estimate_sigma(kernel, u, eps=1e-12):
+    """Read-only sigma estimate ``u^T W v`` from the stored
+    power-iteration vector — the diagnostics view of a layer's spectral
+    norm (``u`` is NOT advanced; the training-time update stays the
+    exclusive job of ``spectral_normalize``). Same (out, rest) matrix
+    view as ``power_iteration`` so tracked sigmas agree with the ones
+    the normalization divides by."""
+    w_mat = kernel.reshape(-1, kernel.shape[-1]).T  # (out, rest)
+    v = _l2_normalize(w_mat.T @ u, eps)
+    return jnp.einsum("o,or,r->", u, w_mat, v)
+
+
 def spectral_normalize(module, kernel, training, name="u", n_steps=1, eps=1e-12):
     """Apply spectral normalization to ``kernel`` inside a linen module.
 

@@ -7,7 +7,7 @@ arrays; feature-matching inputs as list-of-list pytrees — both are
 Python-level structures, static under jit.
 """
 
-from imaginaire_tpu.losses.gan import gan_loss
+from imaginaire_tpu.losses.gan import dis_accuracy, gan_loss
 from imaginaire_tpu.losses.feature_matching import feature_matching_loss
 from imaginaire_tpu.losses.kl import gaussian_kl_loss
 from imaginaire_tpu.losses.perceptual import PerceptualLoss
@@ -15,6 +15,7 @@ from imaginaire_tpu.losses.flow import masked_l1_loss, FlowLoss
 
 __all__ = [
     "gan_loss",
+    "dis_accuracy",
     "feature_matching_loss",
     "gaussian_kl_loss",
     "PerceptualLoss",

@@ -77,3 +77,29 @@ def gan_loss(dis_output, t_real, gan_mode="hinge", dis_update=True,
     return _single_gan_loss(dis_output, t_real, gan_mode, dis_update,
                             target_real_label, target_fake_label,
                             sample_weight)
+
+
+def dis_accuracy(real_outputs, fake_outputs, gan_mode="hinge",
+                 target_real_label=1.0, target_fake_label=0.0):
+    """(real_acc, fake_acc): fraction of discriminator logits on the
+    correct side of the decision boundary — the GAN-balance metric the
+    diagnostics layer tracks (a D pinned at ~100%/~100% starves G of
+    gradient; ~50%/~50% means D learned nothing).
+
+    The boundary is 0 for the logit modes (hinge / non_saturated /
+    wasserstein — for wasserstein the critic is unbounded, so read the
+    number as a separation indicator, not a true accuracy) and the
+    label midpoint for least_square. Accepts the same (possibly nested)
+    list-of-scales structure as ``gan_loss``; scales average equally.
+    """
+    thr = (0.5 * (target_real_label + target_fake_label)
+           if gan_mode == "least_square" else 0.0)
+
+    def frac(out, is_real):
+        if isinstance(out, (list, tuple)):
+            per_scale = [frac(o, is_real) for o in out]
+            return sum(per_scale) / len(per_scale)
+        correct = (out > thr) if is_real else (out <= thr)
+        return jnp.mean(correct.astype(jnp.float32))
+
+    return frac(real_outputs, True), frac(fake_outputs, False)

@@ -106,6 +106,14 @@ class _Span:
         stack = self._tm._span_stack()
         self.parent = stack[-1].name if stack else None
         stack.append(self)
+        if self.name in self._tm.watchdog_exempt:
+            # suspend the hang watchdog for the span's duration: a long
+            # FID/KID eval sweep completes no training steps by design
+            # and must not read as a stall (entering the span IS
+            # progress, so refresh the heartbeat too)
+            with self._tm._lock:
+                self._tm._exempt_depth += 1
+            self._tm.last_heartbeat = self._tm._clock()
         self._wall = time.time()
         self._t0 = time.perf_counter()
         return self
@@ -115,6 +123,13 @@ class _Span:
         stack = self._tm._span_stack()
         if stack and stack[-1] is self:
             stack.pop()
+        if self.name in self._tm.watchdog_exempt:
+            with self._tm._lock:
+                self._tm._exempt_depth = max(self._tm._exempt_depth - 1, 0)
+            # re-arm from NOW — the stall clock must not include the
+            # exempt span's duration, or the watchdog fires the instant
+            # a long eval returns
+            self._tm.last_heartbeat = self._tm._clock()
         self._tm._record_span(self, dur_s)
         return False
 
@@ -127,8 +142,10 @@ class Telemetry:
     def __init__(self, enabled=False, sinks=(), flush_every_n_steps=50,
                  ring_size=512, hang_timeout_s=0.0, trace_at_step=None,
                  trace_num_steps=5, logdir=None, peak_flops=None,
-                 mfu=True):
+                 mfu=True, watchdog_exempt_spans=("eval",)):
         self.enabled = bool(enabled)
+        self.watchdog_exempt = frozenset(watchdog_exempt_spans or ())
+        self._exempt_depth = 0
         self.logdir = logdir
         self.sinks = list(sinks)
         self.flush_every_n_steps = int(flush_every_n_steps or 0)
@@ -295,6 +312,13 @@ class Telemetry:
         if step is not None:
             self.last_step = step
         self.last_heartbeat = self._clock()
+
+    def watchdog_suspended(self):
+        """True while a watchdog-exempt span (``eval`` by default; see
+        ``telemetry.watchdog_exempt_spans``) is open on any thread —
+        the watchdog skips firing instead of flagging a long metric
+        sweep as a hang."""
+        return self._exempt_depth > 0
 
     # ---------------------------------------------------------- tracing
 
@@ -537,6 +561,8 @@ def telemetry_settings(cfg):
         "trace_num_steps": int(cfg_get(tcfg, "trace_num_steps", 5)),
         "peak_flops": cfg_get(tcfg, "peak_flops", None),
         "mfu": bool(cfg_get(tcfg, "mfu", True)),
+        "watchdog_exempt_spans": tuple(
+            cfg_get(tcfg, "watchdog_exempt_spans", None) or ("eval",)),
     }
 
 

@@ -42,6 +42,8 @@ def summarize(events):
     """
     phases = {}
     counters = {}
+    health_series = {}
+    nonfinite_events = []
     meta = {}
     hangs = []
     t_min = t_max = None
@@ -59,7 +61,15 @@ def summarize(events):
             entry.append(float(ev.get("dur_ms", 0) or 0))
         elif kind == "counter":
             counters[ev["name"]] = (ev.get("value"), ev.get("step"))
+            if str(ev["name"]).startswith("health/"):
+                # full series for health counters: trends (grad norms
+                # rising, D/G ratio drifting) are the signal, the
+                # latest value alone is not
+                health_series.setdefault(ev["name"], []).append(
+                    [ev.get("step"), ev.get("value")])
         elif kind == "meta":
+            if ev.get("name") == "nonfinite":
+                nonfinite_events.append(ev)
             meta[ev.get("name", "?")] = ev
         elif kind == "hang":
             hangs.append(ev)
@@ -75,8 +85,74 @@ def summarize(events):
             "share_pct": (sum(durs) / (wall_s * 1e3) * 100.0)
             if wall_s > 0 else 0.0,
         }
+    health = {
+        "has_health_counters": bool(health_series),
+        "series": health_series,
+        "nonfinite_events": nonfinite_events,
+        "nonfinite_event_count": int(
+            counters.get("health/nonfinite_events", (0, None))[0] or 0)
+        or len(nonfinite_events),
+        "nonfinite_skipped": int(
+            counters.get("health/nonfinite_skipped", (0, None))[0] or 0),
+        "dg_ratio_ewma": counters.get("health/dg_loss_ratio_ewma",
+                                      (None, None))[0],
+        "dg_ratio_breaches": len(
+            health_series.get("health/dg_ratio_breach", [])),
+    }
     return {"phases": table, "counters": counters, "meta": meta,
-            "hangs": hangs, "wall_s": wall_s}
+            "hangs": hangs, "wall_s": wall_s, "health": health}
+
+
+def _trend(series):
+    """'first -> last (xN)' for a [[step, value], ...] counter series."""
+    vals = [v for _, v in series if isinstance(v, (int, float))]
+    if not vals:
+        return None
+    if len(vals) == 1:
+        return f"{vals[0]:.4g}"
+    ratio = vals[-1] / vals[0] if vals[0] else float("inf")
+    return f"{vals[0]:.4g} -> {vals[-1]:.4g} (x{ratio:.2f})"
+
+
+def _health_section(s):
+    """Markdown lines for the Health section: grad-norm trends, GAN
+    balance, non-finite events. Empty when the run carried no health
+    counters (diagnostics disabled)."""
+    h = s.get("health") or {}
+    if not h.get("has_health_counters") and not h.get("nonfinite_events"):
+        return []
+    series = h.get("series", {})
+    lines = ["", "## health"]
+    for kind in ("G", "D"):
+        for stat, label in (("grad_norm/_total", "grad norm"),
+                            ("update_ratio/_total", "update/param ratio"),
+                            ("sn_sigma/max", "sn sigma max"),
+                            ("ema_drift", "ema drift")):
+            trend = _trend(series.get(f"health/{kind}/{stat}", []))
+            if trend is not None:
+                lines.append(f"- {kind} {label}: {trend}")
+    for name, label in (("health/D/real_acc", "D real acc"),
+                        ("health/D/fake_acc", "D fake acc")):
+        trend = _trend(series.get(name, []))
+        if trend is not None:
+            lines.append(f"- {label}: {trend}")
+    if h.get("dg_ratio_ewma") is not None:
+        lines.append(f"- D/G loss-ratio EWMA: {h['dg_ratio_ewma']:.4g} "
+                     f"(threshold breaches: {h.get('dg_ratio_breaches', 0)})")
+    n_bad = h.get("nonfinite_event_count", 0)
+    if n_bad:
+        lines.append(f"!! {n_bad} non-finite event(s), "
+                     f"{h.get('nonfinite_skipped', 0)} skipped:")
+        for ev in h.get("nonfinite_events", []):
+            lines.append(
+                f"  - step {ev.get('step')} ({ev.get('update')}): terms "
+                f"{ev.get('culprit_terms')}, modules "
+                f"{ev.get('culprit_modules')}, action {ev.get('action')}"
+                + (f", report {ev.get('report')}" if ev.get("report")
+                   else ""))
+    else:
+        lines.append("- non-finite events: 0")
+    return lines
 
 
 def render_report(path_or_events):
@@ -121,6 +197,7 @@ def render_report(path_or_events):
                      f"({flops_meta.get('source')}, peak "
                      f"{flops_meta.get('peak_flops'):.4g} FLOP/s via "
                      f"{flops_meta.get('peak_source')})")
+    lines.extend(_health_section(s))
     if s["hangs"]:
         lines.append("")
         lines.append(f"!! {len(s['hangs'])} hang dump(s) recorded:")

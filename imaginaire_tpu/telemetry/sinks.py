@@ -116,7 +116,12 @@ class TensorBoardSink(Sink):
 
 
 class ConsoleSink(Sink):
-    """Print the latest counter values as one line per flush."""
+    """Print the latest counter values as one line per flush. Health
+    incidents (``nonfinite`` triage, ``dg_ratio_breach``) print
+    immediately on emit — a diverging run should announce itself before
+    the next flush interval, not after."""
+
+    _ALERT_META = ("nonfinite", "dg_ratio_breach")
 
     def __init__(self, print_fn=None):
         self._latest = {}
@@ -126,6 +131,13 @@ class ConsoleSink(Sink):
         if event.get("kind") == "counter":
             self._latest[event["name"]] = (event["value"],
                                            event.get("step"))
+        elif event.get("kind") == "meta" \
+                and event.get("name") in self._ALERT_META:
+            fields = {k: v for k, v in event.items()
+                      if k not in ("kind", "name", "t")}
+            self._print(f"telemetry ALERT {event['name']}: "
+                        + " ".join(f"{k}={v}"
+                                   for k, v in sorted(fields.items())))
 
     def flush(self):
         if not self._latest:

@@ -12,6 +12,13 @@ thread is parked where: the ``device-prefetch`` producer blocked in
 Fires at most once per stall: after a dump the watchdog re-arms only
 when a fresh heartbeat arrives, so a long hang produces one dump, not a
 dump per poll interval.
+
+Long metric sweeps are exempt: while a span named in
+``telemetry.watchdog_exempt_spans`` (default ``eval``) is open on any
+thread the watchdog skips firing — a FID/KID sweep completes no
+training steps by design — and the span refreshes ``last_heartbeat`` on
+exit so the stall clock re-arms from there instead of firing the
+instant the sweep returns.
 """
 
 from __future__ import annotations
@@ -31,6 +38,12 @@ class HangWatchdog(threading.Thread):
     def run(self):
         fired = False
         while not self._stop_event.wait(self.poll_s):
+            if self._tm.watchdog_suspended():
+                # a watchdog-exempt span (eval sweep) is open: no steps
+                # complete by design. The span refreshes last_heartbeat
+                # on exit, so the stall clock re-arms from there.
+                fired = False
+                continue
             stall = self._tm._clock() - self._tm.last_heartbeat
             if stall >= self.timeout_s:
                 if not fired:

@@ -101,8 +101,22 @@ class BaseTrainer:
         self.time_iteration = None
         self.time_epoch = None
         self._step_flops_probed = False
-        self._jit_gen_step = jax.jit(self._gen_step_fn, donate_argnums=0)
-        self._jit_dis_step = jax.jit(self._dis_step_fn, donate_argnums=0)
+        # Training-health diagnostics (diagnostics/): the step programs
+        # compute a fixed-size health summary at diagnostics.every_n_steps
+        # cadence and guard non-finite updates in-graph; the monitor
+        # polls with one-step lag so the loop stays fence-free.
+        from imaginaire_tpu.diagnostics import HealthMonitor
+
+        self.diag = HealthMonitor(cfg)
+        # --debug-nans repro runs disable donation: jax_debug_nans
+        # re-runs the op eagerly, which would read already-invalidated
+        # donated buffers (see train.py)
+        self._donate = ((0,) if cfg_get(tcfg, "donate_step_buffers", True)
+                        else ())
+        self._jit_gen_step = jax.jit(self._gen_step_fn,
+                                     donate_argnums=self._donate)
+        self._jit_dis_step = jax.jit(self._dis_step_fn,
+                                     donate_argnums=self._donate)
 
     # ------------------------------------------------------------------ setup
 
@@ -210,8 +224,50 @@ class BaseTrainer:
 
     # --------------------------------------------------------- jitted steps
 
+    def _audit_guard(self, losses, grads, state, net_key, opt_key,
+                     new_params, new_opt, new_mut):
+        """Diagnostics seam shared by the G/D step fns: compute the
+        per-step finite flag, guard the update in-graph (a non-finite
+        update never lands — params/opt/mutables keep their previous
+        finite values), and hand back the guarded trees plus the
+        (flag, grad-norm) pair the health summary reuses. Traced into
+        the step programs; a no-op returning ``None`` flags when
+        diagnostics are off."""
+        if not self.diag.enabled:
+            return new_params, new_opt, new_mut, None, None
+        from imaginaire_tpu.diagnostics import audit
+
+        grad_norm = audit.tree_norm(grads)
+        ok = audit.finite_flag(losses["total"], grad_norm)
+        old_vars = state[net_key]
+        new_params = audit.select_finite(ok, new_params, old_vars["params"])
+        new_opt = audit.select_finite(ok, new_opt, state[opt_key])
+        new_mut = {k: (audit.select_finite(ok, v, old_vars[k])
+                       if k in old_vars else v)
+                   for k, v in new_mut.items()}
+        return new_params, new_opt, new_mut, ok, grad_norm
+
+    def _audit_health(self, ok, grad_norm, step_counter, grads, params,
+                      updates, spectral=None, ema=None):
+        """The step program's health summary: per-module norms under the
+        cadence cond, plus the per-step control flags the monitor polls.
+        Returns {} when diagnostics are off (stable step-fn arity)."""
+        if ok is None:
+            return {}
+        from imaginaire_tpu.diagnostics import audit
+
+        pred = (step_counter % self.diag.every_n) == 0
+        health = audit.health_at_cadence(pred, grads, params, updates,
+                                         spectral=spectral, ema=ema,
+                                         grad_norm_total=grad_norm)
+        health["finite"] = ok
+        health["audited"] = pred
+        health["rng_step"] = step_counter
+        return health
+
     def _gen_step_fn(self, state, data):
-        rng = jax.random.fold_in(state["rng_G"], state["step"])
+        step0 = state["step"]
+        rng = jax.random.fold_in(state["rng_G"], step0)
 
         def loss_fn(params_G):
             vars_G = dict(state["vars_G"], params=self._to_compute_dtype(params_G))
@@ -229,9 +285,12 @@ class BaseTrainer:
         updates, new_opt = self.tx_G.update(
             grads, state["opt_G"], state["vars_G"]["params"])
         new_params = optax.apply_updates(state["vars_G"]["params"], updates)
+        new_params, new_opt, new_mut, ok, grad_norm = self._audit_guard(
+            losses, grads, state, "vars_G", "opt_G",
+            new_params, new_opt, new_mut)
         new_vars_G = dict(state["vars_G"], params=new_params, **new_mut)
         state = dict(state, vars_G=new_vars_G, opt_G=new_opt,
-                     step=state["step"] + 1)
+                     step=step0 + 1)
         if self.model_average:
             n = state["num_ema_updates"] + 1
             state["ema_G"] = ema_update(
@@ -241,10 +300,15 @@ class BaseTrainer:
                 spectral=new_vars_G.get("spectral"),
                 remove_sn=self.model_average_remove_sn)
             state["num_ema_updates"] = n
-        return state, losses
+        health = self._audit_health(
+            ok, grad_norm, step0, grads, new_params, updates,
+            spectral=new_vars_G.get("spectral"),
+            ema=state.get("ema_G") if self.model_average else None)
+        return state, losses, health
 
     def _dis_step_fn(self, state, data):
-        rng = jax.random.fold_in(state["rng_D"], state["step_D"])
+        step0 = state["step_D"]
+        rng = jax.random.fold_in(state["rng_D"], step0)
 
         def loss_fn(params_D):
             vars_D = dict(state["vars_D"], params=self._to_compute_dtype(params_D))
@@ -262,9 +326,16 @@ class BaseTrainer:
         updates, new_opt = self.tx_D.update(
             grads, state["opt_D"], state["vars_D"]["params"])
         new_params = optax.apply_updates(state["vars_D"]["params"], updates)
-        state = dict(state, vars_D=dict(state["vars_D"], params=new_params, **new_mut),
-                     opt_D=new_opt, step_D=state["step_D"] + 1)
-        return state, losses
+        new_params, new_opt, new_mut, ok, grad_norm = self._audit_guard(
+            losses, grads, state, "vars_D", "opt_D",
+            new_params, new_opt, new_mut)
+        new_vars_D = dict(state["vars_D"], params=new_params, **new_mut)
+        state = dict(state, vars_D=new_vars_D,
+                     opt_D=new_opt, step_D=step0 + 1)
+        health = self._audit_health(
+            ok, grad_norm, step0, grads, new_params, updates,
+            spectral=new_vars_D.get("spectral"))
+        return state, losses, health
 
     # ------------------------------------------------------------ lifecycle
 
@@ -273,9 +344,14 @@ class BaseTrainer:
         t0 = time.time() if self.speed_benchmark else None
         from imaginaire_tpu.utils.misc import numeric_only
 
+        batch = numeric_only(data)
         with telemetry.span("gen_step", step=self.current_iteration):
-            self.state, losses = self._jit_gen_step(self.state,
-                                                    numeric_only(data))
+            self.state, losses, health = self._jit_gen_step(self.state,
+                                                            batch)
+        # polls the PREVIOUS step's finite flag (already complete — no
+        # pipeline stall) and triggers triage/skip/halt on non-finite
+        self.diag.observe(self, "G", losses, health, batch,
+                          self.current_iteration)
         if self.speed_benchmark:
             jax.block_until_ready(self.state["vars_G"]["params"])
             self._meter("time/gen_step").write(time.time() - t0)
@@ -289,9 +365,12 @@ class BaseTrainer:
         t0 = time.time() if self.speed_benchmark else None
         from imaginaire_tpu.utils.misc import numeric_only
 
+        batch = numeric_only(data)
         with telemetry.span("dis_step", step=self.current_iteration):
-            self.state, losses = self._jit_dis_step(self.state,
-                                                    numeric_only(data))
+            self.state, losses, health = self._jit_dis_step(self.state,
+                                                            batch)
+        self.diag.observe(self, "D", losses, health, batch,
+                          self.current_iteration)
         if self.speed_benchmark:
             jax.block_until_ready(self.state["vars_D"]["params"])
             self._meter("time/dis_step").write(time.time() - t0)
@@ -429,6 +508,10 @@ class BaseTrainer:
         """(ref: base.py:375-405)."""
         self.current_epoch = current_epoch
         self.current_iteration = current_iteration
+        # the last step's health entry is still pending (the monitor
+        # polls with one-step lag); the epoch boundary is a safe place
+        # to block on it
+        self.diag.drain(self)
         self._end_of_epoch(data, current_epoch, current_iteration)
         self.time_epoch = time.time() - self.start_epoch_time
         print(f"Epoch: {current_epoch}, total time: {self.time_epoch:6f}.")

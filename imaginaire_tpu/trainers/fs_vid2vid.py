@@ -86,6 +86,11 @@ class Trainer(Vid2VidTrainer):
                                          training, mutable=True)
         losses = {}
         losses["GAN"], _ = self._gan_fm_losses(d_out["indv"], dis_update=True)
+        from imaginaire_tpu.losses import dis_accuracy
+
+        losses["D_real_acc"], losses["D_fake_acc"] = dis_accuracy(
+            d_out["indv"]["pred_real"]["outputs"],
+            d_out["indv"]["pred_fake"]["outputs"], self.gan_mode)
         for s in range(self.num_temporal_scales):
             if f"temporal_{s}" in d_out:
                 gan_t, _ = self._gan_fm_losses(d_out[f"temporal_{s}"],
@@ -131,8 +136,10 @@ class Trainer(Vid2VidTrainer):
         self.state["opt_D"] = self.tx_D.init(
             self.state["vars_D"]["params"])
         # the step programs closed over the old optimizer: re-trace
-        self._jit_vid_dis = jax.jit(self._vid_dis_step_fn, donate_argnums=0)
-        self._jit_vid_gen = jax.jit(self._vid_gen_step_fn, donate_argnums=0)
+        self._jit_vid_dis = jax.jit(self._vid_dis_step_fn,
+                                    donate_argnums=self._donate)
+        self._jit_vid_gen = jax.jit(self._vid_gen_step_fn,
+                                    donate_argnums=self._donate)
 
         ref_labels = data["ref_labels"]
         ref_images = data["ref_images"]

@@ -66,6 +66,11 @@ class Trainer(BaseTrainer):
                      dis_update=True)
             + gan_loss(d_out["fake_out_trans"], False, self.gan_mode,
                        dis_update=True))}
+        from imaginaire_tpu.losses import dis_accuracy
+
+        losses["D_real_acc"], losses["D_fake_acc"] = dis_accuracy(
+            d_out["real_out_style"], d_out["fake_out_trans"],
+            self.gan_mode)
         if "gp" in self.weights:
             from imaginaire_tpu.utils.misc import gradient_penalty
 

@@ -123,6 +123,13 @@ class Trainer(BaseTrainer):
         gan_b = (gan_loss(d_out["out_b"], True, self.gan_mode, dis_update=True)
                  + gan_loss(d_out["out_ab"], False, self.gan_mode, dis_update=True))
         losses["gan"] = gan_a + gan_b
+        # GAN-balance diagnostics over both domain discriminators
+        # (unweighted keys never enter the total)
+        from imaginaire_tpu.losses import dis_accuracy
+
+        losses["D_real_acc"], losses["D_fake_acc"] = dis_accuracy(
+            [d_out["out_a"], d_out["out_b"]],
+            [d_out["out_ba"], d_out["out_ab"]], self.gan_mode)
 
         if "gp" in self.weights:
             from imaginaire_tpu.utils.misc import gradient_penalty

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from imaginaire_tpu.config import cfg_get
 from imaginaire_tpu.losses import (
     PerceptualLoss,
+    dis_accuracy,
     feature_matching_loss,
     gan_loss,
     gaussian_kl_loss,
@@ -148,6 +149,13 @@ class Trainer(BaseTrainer):
                              True, self.gan_mode, dis_update=True)
         losses = {"GAN/fake": fake_loss, "GAN/true": true_loss,
                   "GAN": fake_loss + true_loss}
+        # GAN-balance diagnostics: D real/fake accuracy rides the loss
+        # dict (unweighted keys never enter the total — _total only sums
+        # registered weights) so it reaches the meters and the health
+        # monitor without an extra forward
+        losses["D_real_acc"], losses["D_fake_acc"] = dis_accuracy(
+            net_D_output["real_outputs"], net_D_output["fake_outputs"],
+            self.gan_mode)
         return losses, new_mut_D
 
     # ---------------------------------------------------------- data hooks

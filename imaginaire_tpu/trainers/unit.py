@@ -67,6 +67,11 @@ class Trainer(MUNITTrainer):
             + gan_loss(d_out["out_ba"], False, self.gan_mode, dis_update=True)
             + gan_loss(d_out["out_b"], True, self.gan_mode, dis_update=True)
             + gan_loss(d_out["out_ab"], False, self.gan_mode, dis_update=True))}
+        from imaginaire_tpu.losses import dis_accuracy
+
+        losses["D_real_acc"], losses["D_fake_acc"] = dis_accuracy(
+            [d_out["out_a"], d_out["out_b"]],
+            [d_out["out_ba"], d_out["out_ab"]], self.gan_mode)
         return losses, new_mut_D
 
     def _get_visualizations(self, data):

@@ -28,7 +28,12 @@ import optax
 
 from imaginaire_tpu import telemetry
 from imaginaire_tpu.config import as_attrdict, cfg_get
-from imaginaire_tpu.losses import PerceptualLoss, feature_matching_loss, gan_loss
+from imaginaire_tpu.losses import (
+    PerceptualLoss,
+    dis_accuracy,
+    feature_matching_loss,
+    gan_loss,
+)
 from imaginaire_tpu.losses.flow import masked_l1_loss
 from imaginaire_tpu.model_utils.fs_vid2vid import concat_frames, skip_stride_span
 from imaginaire_tpu.trainers.base import MUTABLE, BaseTrainer
@@ -50,8 +55,10 @@ class Trainer(BaseTrainer):
             if ds is not None and hasattr(ds, "sequence_length_max"):
                 self.sequence_length_max = min(self.sequence_length_max,
                                                ds.sequence_length_max)
-        self._jit_vid_dis = jax.jit(self._vid_dis_step_fn, donate_argnums=0)
-        self._jit_vid_gen = jax.jit(self._vid_gen_step_fn, donate_argnums=0)
+        self._jit_vid_dis = jax.jit(self._vid_dis_step_fn,
+                                    donate_argnums=self._donate)
+        self._jit_vid_gen = jax.jit(self._vid_gen_step_fn,
+                                    donate_argnums=self._donate)
         # Whole-rollout mode (SURVEY §7 hard-part #3): once the history
         # ring buffers reach their steady-state shapes, the remaining
         # frames run as ONE lax.scan program — per-frame D+G updates with
@@ -61,7 +68,7 @@ class Trainer(BaseTrainer):
         self.rollout_scan = bool(cfg_get(cfg.trainer, "rollout_scan",
                                          False))
         self._jit_rollout_tail = jax.jit(self._rollout_tail_fn,
-                                         donate_argnums=0)
+                                         donate_argnums=self._donate)
 
     # ---------------------------------------------------------------- loss
 
@@ -338,6 +345,11 @@ class Trainer(BaseTrainer):
                                          training, mutable=True)
         losses = {}
         losses["GAN"], _ = self._gan_fm_losses(d_out["indv"], dis_update=True)
+        # GAN-balance diagnostics: per-frame D accuracy on the image D
+        # (unweighted keys never enter the total)
+        losses["D_real_acc"], losses["D_fake_acc"] = dis_accuracy(
+            d_out["indv"]["pred_real"]["outputs"],
+            d_out["indv"]["pred_fake"]["outputs"], self.gan_mode)
         if "raw" in d_out:
             raw_gan, _ = self._gan_fm_losses(d_out["raw"], dis_update=True)
             losses["GAN"] = losses["GAN"] + raw_gan
@@ -352,7 +364,8 @@ class Trainer(BaseTrainer):
     # --------------------------------------------------------- jitted steps
 
     def _vid_gen_step_fn(self, state, data):
-        rng = jax.random.fold_in(state["rng_G"], state["step"])
+        step0 = state["step"]
+        rng = jax.random.fold_in(state["rng_G"], step0)
 
         def loss_fn(params_G):
             vars_G = dict(state["vars_G"],
@@ -373,9 +386,12 @@ class Trainer(BaseTrainer):
         updates, new_opt = self.tx_G.update(
             grads, state["opt_G"], state["vars_G"]["params"])
         new_params = optax.apply_updates(state["vars_G"]["params"], updates)
+        new_params, new_opt, new_mut, ok, grad_norm = self._audit_guard(
+            losses, grads, state, "vars_G", "opt_G",
+            new_params, new_opt, new_mut)
         new_vars_G = dict(state["vars_G"], params=new_params, **new_mut)
         state = dict(state, vars_G=new_vars_G, opt_G=new_opt,
-                     step=state["step"] + 1)
+                     step=step0 + 1)
         if self.model_average:
             n = state["num_ema_updates"] + 1
             state["ema_G"] = ema_update(
@@ -385,10 +401,15 @@ class Trainer(BaseTrainer):
                 spectral=new_vars_G.get("spectral"),
                 remove_sn=self.model_average_remove_sn)
             state["num_ema_updates"] = n
-        return state, losses, jax.lax.stop_gradient(fake)
+        health = self._audit_health(
+            ok, grad_norm, step0, grads, new_params, updates,
+            spectral=new_vars_G.get("spectral"),
+            ema=state.get("ema_G") if self.model_average else None)
+        return state, losses, jax.lax.stop_gradient(fake), health
 
     def _vid_dis_step_fn(self, state, data):
-        rng = jax.random.fold_in(state["rng_D"], state["step_D"])
+        step0 = state["step_D"]
+        rng = jax.random.fold_in(state["rng_D"], step0)
 
         def loss_fn(params_D):
             vars_D = dict(state["vars_D"],
@@ -408,11 +429,16 @@ class Trainer(BaseTrainer):
         updates, new_opt = self.tx_D.update(
             grads, state["opt_D"], state["vars_D"]["params"])
         new_params = optax.apply_updates(state["vars_D"]["params"], updates)
-        state = dict(state,
-                     vars_D=dict(state["vars_D"], params=new_params,
-                                 **new_mut),
-                     opt_D=new_opt, step_D=state["step_D"] + 1)
-        return state, losses
+        new_params, new_opt, new_mut, ok, grad_norm = self._audit_guard(
+            losses, grads, state, "vars_D", "opt_D",
+            new_params, new_opt, new_mut)
+        new_vars_D = dict(state["vars_D"], params=new_params, **new_mut)
+        state = dict(state, vars_D=new_vars_D,
+                     opt_D=new_opt, step_D=step0 + 1)
+        health = self._audit_health(
+            ok, grad_norm, step0, grads, new_params, updates,
+            spectral=new_vars_D.get("spectral"))
+        return state, losses, health
 
     # ------------------------------------------------------------- rollout
 
@@ -478,8 +504,11 @@ class Trainer(BaseTrainer):
                           prev_labels=prev_labels, prev_images=prev_images)
             data_t["past_stacks"] = (
                 self._past_stacks(past_real, past_fake) if use_past else {})
-            state, d_losses = self._vid_dis_step_fn(state, data_t)
-            state, g_losses, fake = self._vid_gen_step_fn(state, data_t)
+            # per-frame health summaries are dropped inside the scan
+            # (stacking them would defeat the fixed-size contract); the
+            # in-graph non-finite guard still protects every tail frame
+            state, d_losses, _ = self._vid_dis_step_fn(state, data_t)
+            state, g_losses, fake, _ = self._vid_gen_step_fn(state, data_t)
             prev_labels = concat_frames(prev_labels, xs["label"],
                                         self.num_frames_G - 1)
             prev_images = concat_frames(prev_images, fake,
@@ -593,10 +622,17 @@ class Trainer(BaseTrainer):
                             if not k.startswith("_")}
                 with telemetry.span("dis_step",
                                     step=self.current_iteration):
-                    self.state, d_losses = self._jit_vid_dis(self.state,
-                                                             data_jit)
-                self.state, g_losses, fake = self._jit_vid_gen(self.state,
-                                                               data_jit)
+                    self.state, d_losses, d_health = self._jit_vid_dis(
+                        self.state, data_jit)
+                # per-frame health hooks: each frame's D and G update
+                # reports its own summary/finite flag (the monitor's
+                # cadence runs on the per-frame step counters)
+                self.diag.observe(self, "D", d_losses, d_health,
+                                  data_jit, self.current_iteration)
+                self.state, g_losses, fake, g_health = self._jit_vid_gen(
+                    self.state, data_jit)
+                self.diag.observe(self, "G", g_losses, g_health,
+                                  data_jit, self.current_iteration)
                 d_hist.append(d_losses)
                 g_hist.append(g_losses)
                 if self.num_temporal_scales > 0:

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""CI / bench gate over a run's telemetry JSONL: exits non-zero when the
+run shows signs of training-health trouble.
+
+Checks (each can fail the gate):
+- non-finite events: any ``nonfinite`` triage meta event or a positive
+  ``health/nonfinite_events`` counter;
+- D/G balance: more than ``--max-dg-breaches`` (default 0)
+  ``health/dg_ratio_breach`` counter emissions;
+- hang dumps: any watchdog ``hang`` event;
+- ``--require-health``: the run must actually carry ``health/*``
+  counters (guards against a config that silently disabled diagnostics
+  — a green gate over a blind run is worse than a red one).
+
+Usage:
+    python scripts/check_run_health.py logs/<run>            # dir works
+    python scripts/check_run_health.py logs/<run>/telemetry.jsonl
+    python scripts/check_run_health.py <path> --require-health --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from imaginaire_tpu.telemetry.report import (  # noqa: E402
+    load_events,
+    summarize,
+)
+
+
+def check_health(summary, require_health=False, max_dg_breaches=0):
+    """Return the list of failure strings for an aggregated summary."""
+    failures = []
+    health = summary.get("health") or {}
+    n_bad = health.get("nonfinite_event_count", 0)
+    if n_bad:
+        events = health.get("nonfinite_events") or []
+        detail = "; ".join(
+            f"step {e.get('step')} ({e.get('update')}): "
+            f"terms {e.get('culprit_terms')} modules "
+            f"{e.get('culprit_modules')}" for e in events) or "see jsonl"
+        failures.append(f"{n_bad} non-finite event(s): {detail}")
+    breaches = health.get("dg_ratio_breaches", 0)
+    if breaches > max_dg_breaches:
+        failures.append(
+            f"{breaches} D/G loss-ratio threshold breach(es) "
+            f"(ewma {health.get('dg_ratio_ewma')}, allowed "
+            f"{max_dg_breaches})")
+    if summary.get("hangs"):
+        failures.append(f"{len(summary['hangs'])} watchdog hang dump(s)")
+    if require_health and not health.get("has_health_counters"):
+        failures.append(
+            "no health/* counters in the run (diagnostics disabled or "
+            "the run died before the first audit cadence)")
+    return failures
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Health gate over a run's telemetry.jsonl")
+    ap.add_argument("path", help="telemetry.jsonl (or a run dir "
+                                 "containing one)")
+    ap.add_argument("--require-health", action="store_true",
+                    help="fail unless health/* counters are present")
+    ap.add_argument("--max-dg-breaches", type=int, default=0,
+                    help="tolerated health/dg_ratio_breach emissions "
+                         "(default 0)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the verdict as JSON")
+    args = ap.parse_args(argv)
+    path = args.path
+    if os.path.isdir(path):
+        path = os.path.join(path, "telemetry.jsonl")
+    if not os.path.exists(path):
+        print(f"check_run_health: no telemetry.jsonl at {path}",
+              file=sys.stderr)
+        return 2
+    summary = summarize(load_events(path))
+    failures = check_health(summary, require_health=args.require_health,
+                            max_dg_breaches=args.max_dg_breaches)
+    health = summary.get("health") or {}
+    if args.json:
+        print(json.dumps({
+            "path": path,
+            "healthy": not failures,
+            "failures": failures,
+            "nonfinite_events": health.get("nonfinite_event_count", 0),
+            "nonfinite_skipped": health.get("nonfinite_skipped", 0),
+            "dg_ratio_ewma": health.get("dg_ratio_ewma"),
+            "dg_ratio_breaches": health.get("dg_ratio_breaches", 0),
+            "has_health_counters": health.get("has_health_counters",
+                                              False),
+        }, indent=1, default=str))
+    elif failures:
+        for failure in failures:
+            print(f"check_run_health: FAIL — {failure}")
+    else:
+        print(f"check_run_health: OK — {path} "
+              f"(health counters: "
+              f"{'yes' if health.get('has_health_counters') else 'no'})")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -119,12 +119,12 @@ def main_variant(variant, with_temporal, flow_teacher, results):
           f"{jax.devices()[0]}", flush=True)
 
     def dis_frame():
-        trainer.state, _ = trainer._jit_vid_dis(trainer.state, data_t)
+        trainer.state, _, _h = trainer._jit_vid_dis(trainer.state, data_t)
         return trainer.state["vars_D"]["params"]
 
     def gen_frame():
-        trainer.state, _, fake = trainer._jit_vid_gen(trainer.state,
-                                                      data_t)
+        trainer.state, _, fake, _h = trainer._jit_vid_gen(trainer.state,
+                                                          data_t)
         return fake
 
     rng = jax.random.PRNGKey(1)

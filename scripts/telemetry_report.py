@@ -1,7 +1,12 @@
 #!/usr/bin/env python
 """Render a run's telemetry.jsonl into the PROFILE.md-style per-phase
 attribution table (counts, totals, p50/p99, share of wall) plus the
-derived counters (imgs/sec, MFU, step percentiles) and hang dumps.
+derived counters (imgs/sec, MFU, step percentiles), the training-health
+section (grad-norm / update-ratio trends, D real/fake accuracy, D/G
+loss-ratio EWMA with breach counts, non-finite triage events), and hang
+dumps. ``--json`` includes every counter plus the full ``health`` block
+(health counter series, nonfinite events) — the machine-readable feed
+``scripts/check_run_health.py`` gates on.
 
 Usage:
     python scripts/telemetry_report.py logs/<run>/telemetry.jsonl

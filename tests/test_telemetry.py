@@ -147,6 +147,35 @@ def test_watchdog_dumps_producer_thread_stack(tm_sandbox, tmp_path):
     assert len(hangs) == 1
 
 
+def test_watchdog_suspended_during_eval_span(tm_sandbox, tmp_path):
+    """ISSUE 3 satellite: a long FID/KID sweep (an open ``eval`` span)
+    must not read as a hang — and the stall clock re-arms when the span
+    exits, so the watchdog stays live for real post-eval stalls."""
+    tm = telemetry.configure(logdir=str(tmp_path), enabled=True,
+                             sinks=["jsonl"], flush_every_n_steps=0,
+                             hang_timeout_s=0.15)
+    tm.step_complete(1, items=1)
+    path = str(tmp_path / "telemetry.jsonl")
+    with tm.span("eval", step=1):
+        assert tm.watchdog_suspended()
+        time.sleep(0.6)  # 4x the timeout, all inside the eval span
+    assert not tm.watchdog_suspended()
+    time.sleep(0.05)
+    tm._push_to_sinks()
+    hangs = [e for e in _read_jsonl(path)] if os.path.exists(path) else []
+    assert not [e for e in hangs if e["kind"] == "hang"], \
+        "watchdog fired during an eval span"
+    # exiting the span re-armed the clock from NOW: a real stall after
+    # eval still fires
+    deadline = time.time() + 10
+    fired = []
+    while time.time() < deadline and not fired:
+        time.sleep(0.05)
+        if os.path.exists(path):
+            fired = [e for e in _read_jsonl(path) if e["kind"] == "hang"]
+    assert fired, "watchdog armed-after-eval never fired on a real stall"
+
+
 def test_mfu_counter_matches_hand_computed_value(tm_sandbox):
     sink = CaptureSink()
     tm = telemetry.configure(enabled=True, sinks=[sink],

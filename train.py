@@ -27,6 +27,15 @@ def parse_args():
     parser.add_argument("--seed", type=int, default=2)
     parser.add_argument("--max_iter", type=int, default=None,
                         help="override cfg max_iter (smoke tests)")
+    parser.add_argument("--debug-nans", action="store_true",
+                        help="enable jax_debug_nans for CPU repro runs: "
+                             "every primitive's output is checked and the "
+                             "first NaN raises with the op's stack trace. "
+                             "Implies trainer.donate_step_buffers=False — "
+                             "the de-jitted re-run reads buffers donation "
+                             "would already have invalidated. Expect a "
+                             "large slowdown; pair with JAX_PLATFORMS=cpu "
+                             "and a tiny config.")
     return parser.parse_args()
 
 
@@ -36,6 +45,16 @@ def main():
     cfg = Config(args.config)
     if args.max_iter is not None:
         cfg.max_iter = args.max_iter
+    if args.debug_nans:
+        # the coarse in-run triage (diagnostics/) names the term/module;
+        # this flag is the fine-grained follow-up that names the exact
+        # primitive. Donation must be off: jax_debug_nans re-executes
+        # the step de-jitted, and the jitted call already consumed the
+        # donated state buffers.
+        jax.config.update("jax_debug_nans", True)
+        cfg.trainer.donate_step_buffers = False
+        print("--debug-nans: jax_debug_nans on, step-buffer donation off "
+              "(expect higher memory + much slower steps)")
 
     set_mesh(create_mesh(tuple(cfg.runtime.mesh.axes), cfg.runtime.mesh.shape))
     date_uid, logdir = init_logging(args.config, args.logdir)
@@ -116,18 +135,23 @@ def main():
             if current_iteration >= max_iter:
                 print("Done with training!!!")
                 trainer.save_checkpoint(epoch, current_iteration)
-                _finalize_run()
+                _finalize_run(trainer)
                 return
         trainer.end_of_epoch(data, epoch, current_iteration)
     print("Done with training!!!")
-    _finalize_run()
+    _finalize_run(trainer)
 
 
-def _finalize_run():
-    """Async checkpoint saves must commit — and telemetry must flush its
-    final window — before the process exits."""
+def _finalize_run(trainer=None):
+    """Async checkpoint saves must commit — and the health monitor's
+    pending step plus telemetry's final window must flush — before the
+    process exits."""
     from imaginaire_tpu.utils.checkpoint import wait_for_pending_checkpoint
 
+    if trainer is not None:
+        # the monitor polls with one-step lag; the final step's health
+        # entry (and any non-finite verdict) is still pending here
+        trainer.diag.drain(trainer)
     wait_for_pending_checkpoint()
     telemetry.get().shutdown()
 
