@@ -127,7 +127,8 @@ def build_unit():
     return trainer, n_seg + 1
 
 
-def build_vid2vid(flow_teacher=True, hw=(512, 1024), rollout_scan=False):
+def build_vid2vid(flow_teacher=True, hw=(512, 1024), rollout_scan=False,
+                  flow_cache=None):
     """The shipped cityscapes vid2vid recipe (512x1024, bs2, interleaved
     per-frame D+G rollout with flow warp + multi-SPADE combine).
     ``hw`` below (512, 1024) is the measured-fallback size for the
@@ -140,6 +141,10 @@ def build_vid2vid(flow_teacher=True, hw=(512, 1024), rollout_scan=False):
                               "configs", "projects", "vid2vid", "cityscapes",
                               "bf16.yaml"))
     cfg.trainer.rollout_scan = rollout_scan
+    if flow_cache is not None:
+        # teacher-amortization A/B legs (run_teacher_ab): e.g.
+        # {"enabled": True, "mode": "disk", "dir": ...}
+        cfg.flow_cache = dict(flow_cache)
     # no pretrained VGG / FlowNet2 weights in this environment; random
     # weights cost the same (the FlowNet2 teacher stays in the graph)
     cfg.trainer.perceptual_loss.allow_random_init = True
@@ -176,6 +181,122 @@ def vid2vid_batch(bs, t, label_ch, h=512, w=1024):
         "images": rng.rand(bs, t, h, w, 3).astype(np.float32) * 2 - 1,
         "label": lab,
     }
+
+
+def _merge_vidbench(extra):
+    """Merge keys into VIDBENCH.json without clobbering the tracked
+    metric time series."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "VIDBENCH.json")
+    book = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            book = json.load(f)
+    book.update(extra)
+    with open(path, "w") as f:
+        json.dump(book, f, indent=1)
+
+
+def run_teacher_ab(width="zoo", hw=(256, 512), bs=2, seq_len=4, iters=4):
+    """Teacher-amortization A/B (ISSUE 4 satellite): the same vid2vid
+    step driven three ways — FlowNet2 teacher in-graph (the reference
+    semantics), amortized producer-mode cold (teacher recomputed
+    off-step every iteration), and cache-warm (on-disk hit, ~zero
+    teacher cost) — recording ``teacher_cache_speedup_pct`` and
+    ``flow_cache_hit_rate`` into VIDBENCH.json as first-class
+    regression metrics. ``--width unit`` runs the 64x64 unit-test
+    recipe (CPU-feasible smoke); ``zoo`` the cityscapes recipe at the
+    bench operating point."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    cache_dir = tempfile.mkdtemp(prefix="flow_cache_ab_")
+    leg_cache_cfg = {
+        "in_graph": {"enabled": False},
+        "producer_cold": {"enabled": True, "mode": "producer"},
+        "cache_warm": {"enabled": True, "mode": "disk", "dir": cache_dir},
+    }
+
+    def build(leg):
+        if width == "unit":
+            from imaginaire_tpu.config import Config
+            from imaginaire_tpu.registry import resolve
+
+            cfg = Config(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "configs",
+                "unit_test", "vid2vid_street.yaml"))
+            cfg.flow_network = {"allow_random_init": True}
+            cfg.flow_cache = dict(leg_cache_cfg[leg])
+            trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+            rng = np.random.RandomState(0)
+            t = 3
+            data = {
+                "images": rng.rand(1, t, 64, 64, 3).astype(
+                    np.float32) * 2 - 1,
+                "label": (rng.rand(1, t, 64, 64, 12) > 0.9).astype(
+                    np.float32),
+            }
+            return trainer, data, t
+        trainer, label_ch = build_vid2vid(True, hw,
+                                          flow_cache=leg_cache_cfg[leg])
+        data = vid2vid_batch(bs, seq_len, label_ch, h=hw[0], w=hw[1])
+        return trainer, data, bs * seq_len
+
+    rates, hit_rate = {}, None
+    for leg in ("in_graph", "producer_cold", "cache_warm"):
+        jax.clear_caches()
+        trainer, data, n_units = build(leg)
+        first = trainer.start_of_iteration(dict(data), 0)
+        trainer.init_state(jax.random.PRNGKey(0), first)
+
+        def sync():
+            leaf = jax.tree_util.tree_leaves(
+                trainer.state["vars_G"]["params"])[0]
+            return float(jnp.sum(leaf))
+
+        for i in range(2):  # compile + warm (and populate the store)
+            batch = trainer.start_of_iteration(dict(data), i)
+            trainer.dis_update(batch)
+            trainer.gen_update(batch)
+        sync()
+        t0 = time.time()
+        for i in range(iters):
+            batch = trainer.start_of_iteration(dict(data), i)
+            trainer.dis_update(batch)
+            trainer.gen_update(batch)
+        sync()
+        rates[leg] = n_units * iters / (time.time() - t0)
+        if leg == "cache_warm" and trainer.flow_cache is not None:
+            hit_rate = trainer.flow_cache.hit_rate()
+            assert "flownet" not in (trainer.state["loss_params"] or {}), \
+                "flow cache active but the step program still carries " \
+                "the FlowNet2 param tree"
+        trainer.state = None
+
+    speedup_pct = (rates["cache_warm"] / rates["in_graph"] - 1.0) * 100.0
+    payload = {
+        "teacher_cache_speedup_pct": round(speedup_pct, 2),
+        "flow_cache_hit_rate": (round(hit_rate, 4)
+                                if hit_rate is not None else None),
+        "teacher_ab": {
+            "width": width,
+            "platform": jax.devices()[0].platform,
+            "in_graph_fps": round(rates["in_graph"], 3),
+            "producer_cold_fps": round(rates["producer_cold"], 3),
+            "cache_warm_fps": round(rates["cache_warm"], 3),
+            "iters": iters,
+        },
+    }
+    _merge_vidbench(payload)
+    print(json.dumps({
+        "metric": "vid2vid_teacher_cache_speedup_pct",
+        "value": round(speedup_pct, 2),
+        "unit": "pct",
+        "vs_baseline": None,
+    }))
+    return payload
 
 
 def run_vid2vid(seq_len=4):
@@ -299,6 +420,17 @@ def run_vid2vid(seq_len=4):
                                leg_telemetry=leg_telemetry),
                           f, indent=1)
             print(json.dumps(payload))
+            # teacher-amortization A/B at the winning operating point
+            # (best-effort: an A/B failure must not cost the headline)
+            if flow_teacher:
+                try:
+                    trainer.state = None
+                    trainer = None
+                    jax.clear_caches()
+                    run_teacher_ab(width="zoo", hw=hw, bs=bs,
+                                   seq_len=seq_len)
+                except Exception as e:  # noqa: BLE001
+                    print(f"# teacher A/B legs failed: {e!r}", flush=True)
             return
         except Exception as e:  # OOM / compiler cap -> next leg
             last_error = e
@@ -865,7 +997,17 @@ def main():
                         help="measure the training-health diagnostics "
                              "overhead (on vs off) on the SPADE step "
                              "at --width and record DIAGBENCH.json")
+    parser.add_argument("--teacher-ab", action="store_true",
+                        help="vid2vid teacher-amortization A/B only "
+                             "(in-graph vs producer-cold vs cache-warm) "
+                             "-> VIDBENCH.json teacher_cache_speedup_pct; "
+                             "--width unit runs the CPU-feasible 64x64 "
+                             "smoke, zoo the cityscapes recipe")
     args = parser.parse_args()
+    if args.teacher_ab:
+        run_teacher_ab(width=args.width if args.width == "unit" else "zoo",
+                       hw=(256, 512))
+        return
     if args.diag_ab:
         run_diag_ab(width=args.width)
         return

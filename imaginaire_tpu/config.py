@@ -251,6 +251,26 @@ def default_config():
             dg_ratio_warn_high=10.0,
             max_triage_terms=16,  # cap on the per-term grad triage pass
         ),
+        # -- frozen-teacher flow amortization (flow/cache.py): with
+        # enabled, the FlowNet2 teacher's (flow, conf) ground truth is
+        # computed OFF the step program's critical path — in the
+        # DevicePrefetcher producer thread, overlapped with the running
+        # step — and rides the batch as plain numeric inputs, so the
+        # compiled D/G step programs carry no FlowNet2 parameters.
+        # mode: 'producer' recomputes every epoch (overlap only);
+        # 'disk' adds the content-addressed on-disk cache (keyed by
+        # sample id + frame pair + canonical resolution — epoch >= 2 is
+        # a hit and pays ~zero teacher cost; crop/hflip augmentations
+        # are applied to the cached canonical-resolution flow
+        # equivariantly); 'auto' uses disk when a cache dir resolves
+        # (flow_cache.dir or <logdir>/flow_cache), else producer.
+        # enabled: false keeps the reference's in-graph teacher.
+        flow_cache=AttrDict(
+            enabled=False,
+            mode="auto",  # auto | producer | disk
+            dir=None,  # None -> <logdir>/flow_cache
+            store_dtype="float16",  # on-disk flow dtype (conf is uint8)
+        ),
         # -- TPU runtime (replaces ref cudnn/local_rank blocks, config.py:143-150)
         runtime=AttrDict(
             mesh=AttrDict(axes=["data"], shape=None),  # shape None => all devices on 'data'

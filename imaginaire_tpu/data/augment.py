@@ -21,6 +21,7 @@ readable for ``vis::`` ops (ref: datasets/base.py:489-503).
 from __future__ import annotations
 
 import random
+import threading
 
 import cv2
 import numpy as np
@@ -38,6 +39,31 @@ def _parse_hw(value):
     return int(h), int(w)
 
 
+def deterministic_resize_chain(aug_cfg, hw):
+    """The resize ops a sample of original size ``hw`` deterministically
+    receives from ``aug_cfg`` — shared between the Augmentor and the
+    flow-cache precompute CLI so both produce bit-identical canonical
+    frames. Returns (ops, (h, w), deterministic): ``deterministic`` is
+    False when the config carries randomized resize keys
+    (random_resize_h_w_aspect / random_scale_limit), in which case the
+    caller must fall back to the Augmentor's own per-sample draw."""
+    cfg = dict(aug_cfg or {})
+    h, w = hw
+    ops = []
+    if "resize_smallest_side" in cfg:
+        s = int(cfg["resize_smallest_side"])
+        scale = s / min(h, w)
+        h, w = int(round(h * scale)), int(round(w * scale))
+        ops.append(("resize", (h, w)))
+    if "resize_h_w" in cfg:
+        h, w = _parse_hw(cfg["resize_h_w"])
+        ops.append(("resize", (h, w)))
+    deterministic = not ("random_resize_h_w_aspect" in cfg
+                         or ("random_scale_limit" in cfg
+                             and "resize_smallest_side" in cfg))
+    return ops, (h, w), deterministic
+
+
 class Augmentor:
     def __init__(self, aug_cfg, interpolators=None, keypoint_data_types=None):
         self.cfg = dict(aug_cfg or {})
@@ -51,6 +77,25 @@ class Augmentor:
         self.crop_h = 0
         self.crop_w = 0
         self.is_flipped = False
+        # Flow-cache support: data types whose CANONICAL frames (after
+        # the resize ops, before crop/flip) are stashed per call, plus a
+        # per-call record of the spatial params. Thread-local — the
+        # loader's prefetch workers share one Augmentor instance.
+        self.capture_canonical_types = set()
+        self._tls = threading.local()
+
+    @property
+    def last_record(self):
+        """Spatial-augmentation record of this thread's last
+        ``perform_augmentation`` call (see _make_record)."""
+        return getattr(self._tls, "record", None)
+
+    @property
+    def last_canonical(self):
+        """{data_type: [canonical HWC frames]} captured for
+        ``capture_canonical_types`` on this thread's last call (only
+        when the record's ``canonical_ok``)."""
+        return getattr(self._tls, "canonical", {})
 
     def _interp(self, data_type):
         return _INTERP.get(self.interpolators.get(data_type), cv2.INTER_LINEAR)
@@ -62,16 +107,10 @@ class Augmentor:
         self.original_h, self.original_w = first.shape[:2]
         h, w = first.shape[:2]
 
-        ops = []
         cfg = self.cfg
-        if "resize_smallest_side" in cfg:
-            s = int(cfg["resize_smallest_side"])
-            scale = s / min(h, w)
-            h, w = int(round(h * scale)), int(round(w * scale))
-            ops.append(("resize", (h, w)))
-        if "resize_h_w" in cfg:
-            h, w = _parse_hw(cfg["resize_h_w"])
-            ops.append(("resize", (h, w)))
+        ops, (h, w), resize_deterministic = deterministic_resize_chain(
+            cfg, (h, w))
+        ops = list(ops)
         if "random_resize_h_w_aspect" in cfg:
             # 'H,W' base with aspect jitter from random_scale_limit.
             bh, bw = _parse_hw(cfg["random_resize_h_w_aspect"])
@@ -113,6 +152,27 @@ class Augmentor:
             self.crop_h, self.crop_w = h, w
         self.is_flipped = is_flipped
 
+        # canonical split for the flow cache: everything up to the first
+        # non-resize op is "canonical" (the resolution flow is computed
+        # and cached at); the remainder must be pure crop/hflip for the
+        # equivariant flow transform to be valid
+        cut = len(ops)
+        for i, (op, _) in enumerate(ops):
+            if op != "resize":
+                cut = i
+                break
+        canonical_ok = all(op in ("crop", "hflip") for op, _ in ops[cut:])
+        record = {
+            "original_hw": (self.original_h, self.original_w),
+            "canonical_hw": (h, w),
+            "crop": crop,  # (top, left, ch, cw) in canonical coords
+            "hflip": is_flipped,
+            "canonical_ok": canonical_ok,
+            "resize_deterministic": resize_deterministic,
+        }
+        self._tls.record = record
+        canonical = {}
+
         out = {}
         for data_type, frames in inputs.items():
             if data_type in self.keypoint_data_types:
@@ -126,7 +186,17 @@ class Augmentor:
                 out[data_type] = frames
                 continue
             interp = self._interp(data_type)
-            out[data_type] = [self._apply(f, ops, interp) for f in frames]
+            if canonical_ok and data_type in self.capture_canonical_types:
+                # run the chain in two halves through the SAME _apply so
+                # the augmented output stays bit-identical: canonical is
+                # the mid-chain state, not a recomputation
+                pre = [self._apply(f, ops[:cut], interp) for f in frames]
+                canonical[data_type] = pre
+                out[data_type] = [self._apply(f, ops[cut:], interp)
+                                  for f in pre]
+            else:
+                out[data_type] = [self._apply(f, ops, interp) for f in frames]
+        self._tls.canonical = canonical
         return out, is_flipped
 
     def _apply_keypoints(self, pts, ops):

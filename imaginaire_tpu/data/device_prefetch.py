@@ -33,6 +33,15 @@ flag and drains the queue so a blocked producer put always unwinds.
 Config: the ``data.device_prefetch`` knob ({enabled, depth}, defaults
 on / depth 2) is honored by every family config via the defaults tree;
 with it off, consumers keep the synchronous ``to_device`` path.
+
+The producer thread is also where the vid2vid family's amortized
+FlowNet2 teacher executes (``flow/cache.py``): the trainer's
+``_start_of_iteration`` hook — run here as ``host_preprocess`` —
+attaches the teacher's ``(flow, conf)`` ground truth to the batch, so
+the 52.2 ms/frame teacher forward overlaps the running step and its
+outputs ship through the same committed-sharding transfer as the rest
+of the batch (the ``flow_teacher`` span nests under
+``prefetch_preprocess`` in the phase table).
 """
 
 from __future__ import annotations

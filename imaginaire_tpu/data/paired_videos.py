@@ -41,6 +41,30 @@ class Dataset(BaseDataset):
         self.sequence_length = min(self.sequence_length,
                                    max(self.sequence_length_max, 1))
         self._rebuild()
+        # Teacher flow cache, dataset half (flow/cache.py): on training
+        # items, look the canonical-resolution (flow, conf) pairs up in
+        # the on-disk store from the loader worker threads (hits load
+        # here, in parallel; misses ship canonical frames for the
+        # producer-thread teacher). Inference items never carry flow
+        # supervision.
+        self._flow_hook = None
+        if not is_inference and not is_test and self.input_image:
+            from imaginaire_tpu.flow.cache import (
+                DatasetFlowCacheHook,
+                flow_cache_settings,
+            )
+
+            if flow_cache_settings(cfg).enabled \
+                    and cfg_get(cfg, "flow_network", None) is not None:
+                image_type = self.input_image[0]
+                hook = DatasetFlowCacheHook(
+                    cfg, self.name, image_type,
+                    self.normalize.get(image_type, False),
+                    weights_path=cfg_get(cfg.flow_network, "weights_path",
+                                         None))
+                if hook.active:
+                    self._flow_hook = hook
+                    self.augmentor.capture_canonical_types.add(image_type)
 
     def set_sequence_length(self, sequence_length):
         """(ref: paired_videos.py:74-89)."""
@@ -121,6 +145,13 @@ class Dataset(BaseDataset):
         finally:
             self._signal_first_frame(frame_idx)
         out = self.concat_labels(out)  # keeps (T, H, W, C)
+        if self._flow_hook is not None and frame_idx is None \
+                and len(frames) >= 2:
+            out = self._flow_hook.attach_item(
+                out, root_idx, seq, list(frames),
+                self.augmentor.last_record,
+                (self.augmentor.last_canonical or {}).get(
+                    self._flow_hook.image_type))
         out["key"] = f"{seq}/{frames[-1]}"
         return out
 

@@ -1,5 +1,14 @@
-"""FlowNet2 port (ref: imaginaire/third_party/flow_net)."""
+"""FlowNet2 port (ref: imaginaire/third_party/flow_net) plus the
+teacher-output amortization layer (flow/cache.py)."""
 
+from imaginaire_tpu.flow.cache import (
+    DatasetFlowCacheHook,
+    FlowCacheStore,
+    TeacherFlowCache,
+    flow_cache_settings,
+    resolve_cache_dir,
+    transform_flow,
+)
 from imaginaire_tpu.flow.flow_net import FlowNet
 from imaginaire_tpu.flow.flownet2 import (
     FlowNet2,
@@ -10,4 +19,6 @@ from imaginaire_tpu.flow.flownet2 import (
 )
 
 __all__ = ["FlowNet", "FlowNet2", "FlowNetC", "FlowNetS", "FlowNetSD",
-           "FlowNetFusion"]
+           "FlowNetFusion", "TeacherFlowCache", "FlowCacheStore",
+           "DatasetFlowCacheHook", "flow_cache_settings",
+           "resolve_cache_dir", "transform_flow"]

@@ -47,12 +47,16 @@ class FlowLoss:
 
     Args:
         flow_net: ``(im_a, im_b) -> (flow, conf)`` frozen flow estimator;
-            outputs are stop_gradient'ed here.
+            outputs are stop_gradient'ed here. May be ``None`` when the
+            ground truth arrives precomputed (the flow-cache path): the
+            data dict then carries ``flow_gt``/``conf_gt`` for the prev
+            pair — computed off the step program by ``flow/cache.py`` —
+            and the step program never contains the teacher cascade.
         warp_ref: also supervise reference->target warping (fs-vid2vid).
         has_fg: weight flow L1 by a foreground mask from the label map.
     """
 
-    def __init__(self, flow_net: Callable, warp_ref: bool = False,
+    def __init__(self, flow_net: Optional[Callable], warp_ref: bool = False,
                  has_fg: bool = False):
         self.flow_net = flow_net
         self.warp_ref = warp_ref
@@ -74,13 +78,18 @@ class FlowLoss:
         occ_masks = net_G_output["fake_occlusion_masks"]
         fg_mask = data.get("fg_mask", 1.0) if self.has_fg else 1.0
 
-        # Ground-truth flow/conf from the frozen flow net (ref: flow.py:95-117).
+        # Ground-truth flow/conf from the frozen flow net (ref: flow.py:95-117)
+        # — or precomputed off-step by the flow cache (data['flow_gt']).
         flow_gt, conf_gt = [], []
         if self.warp_ref:
             f, c = self._gt(tgt_image, data["ref_image"])
             flow_gt.append(f)
             conf_gt.append(c)
-        if compute_prev and data.get("real_prev_image") is not None:
+        if compute_prev and data.get("flow_gt") is not None:
+            flow_gt.append(jax.lax.stop_gradient(data["flow_gt"]))
+            conf_gt.append(jax.lax.stop_gradient(data["conf_gt"]))
+        elif compute_prev and data.get("real_prev_image") is not None \
+                and self.flow_net is not None:
             f, c = self._gt(tgt_image, data["real_prev_image"])
             flow_gt.append(f)
             conf_gt.append(c)

@@ -43,6 +43,7 @@ def summarize(events):
     phases = {}
     counters = {}
     health_series = {}
+    flow_cache_series = {}
     nonfinite_events = []
     meta = {}
     hangs = []
@@ -67,6 +68,9 @@ def summarize(events):
                 # latest value alone is not
                 health_series.setdefault(ev["name"], []).append(
                     [ev.get("step"), ev.get("value")])
+            elif str(ev["name"]).startswith("flow_cache/"):
+                flow_cache_series.setdefault(ev["name"], []).append(
+                    float(ev.get("value") or 0.0))
         elif kind == "meta":
             if ev.get("name") == "nonfinite":
                 nonfinite_events.append(ev)
@@ -99,8 +103,19 @@ def summarize(events):
         "dg_ratio_breaches": len(
             health_series.get("health/dg_ratio_breach", [])),
     }
+    # amortized-teacher health (informational — never gated on): the
+    # hit rate tells a cold epoch from a warm one, compute_ms how much
+    # producer-thread time the teacher takes
+    flow_cache = {"present": bool(flow_cache_series)}
+    if flow_cache_series.get("flow_cache/hit_rate"):
+        flow_cache["hit_rate"] = flow_cache_series[
+            "flow_cache/hit_rate"][-1]
+    if flow_cache_series.get("flow_cache/compute_ms"):
+        series = flow_cache_series["flow_cache/compute_ms"]
+        flow_cache["compute_ms_mean"] = sum(series) / len(series)
     return {"phases": table, "counters": counters, "meta": meta,
-            "hangs": hangs, "wall_s": wall_s, "health": health}
+            "hangs": hangs, "wall_s": wall_s, "health": health,
+            "flow_cache": flow_cache}
 
 
 def _trend(series):

@@ -67,6 +67,20 @@ class Trainer(Vid2VidTrainer):
                         jax.lax.stop_gradient(occ)))
             if flow_terms:
                 losses["Flow"] = sum(flow_terms) / len(flow_terms)
+            if "Flow_L1" in self.weights \
+                    and data_t.get("flow_gt") is not None:
+                # amortized-teacher direct flow supervision on the prev
+                # branch (the reference's FlowLoss L1 term,
+                # flow.py:120-160, previously skipped by this fork): the
+                # cached (flow, conf) makes it free at step time
+                flows = out.get("fake_flow_maps")
+                prev_flow = flows[-1] if isinstance(flows, (list, tuple)) \
+                    else flows
+                if prev_flow is not None:
+                    losses["Flow_L1"] = masked_l1_loss(
+                        prev_flow,
+                        jax.lax.stop_gradient(data_t["flow_gt"]),
+                        jax.lax.stop_gradient(data_t["conf_gt"]))
         for s in range(self.num_temporal_scales):
             if f"temporal_{s}" in d_out:
                 gan_t, fm_t = self._gan_fm_losses(d_out[f"temporal_{s}"],

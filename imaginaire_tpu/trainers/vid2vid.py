@@ -92,6 +92,7 @@ class Trainer(BaseTrainer):
             self.weights["L1"] = lw.L1
         self.use_flow = cfg_get(cfg.gen, "flow", None) is not None
         self.flow_net_wrapper = None
+        self.flow_cache = None
         if self.use_flow:
             self.weights["Flow"] = lw.flow
             # Full FlowLoss with a frozen FlowNet2 teacher when
@@ -111,9 +112,35 @@ class Trainer(BaseTrainer):
                     self.weights["Flow_L1"] = self.weights["Flow_Warp"] = \
                         self.weights["Flow_Mask"] = lw.flow
                 except FileNotFoundError as e:
-                    print(f"FlowNet2 teacher unavailable ({e}); using "
-                          "warp-consistency flow loss.")
+                    import logging
+
+                    msg = (f"FlowNet2 teacher unavailable ({e}); using "
+                           "warp-consistency flow loss.")
+                    logging.getLogger(__name__).warning(msg)
+                    # mirror into the run JSONL so a post-hoc reader can
+                    # tell a teacherless run from a teacher-supervised one
+                    telemetry.get().meta("flow_teacher_unavailable",
+                                         reason=str(e), fallback="warp_"
+                                         "consistency_masked_l1")
                     self.flow_net_wrapper = None
+        if self.flow_net_wrapper is not None:
+            # teacher amortization (flow/cache.py): run the frozen
+            # teacher OFF the step program — in the prefetch producer
+            # thread, with an optional on-disk canonical-resolution
+            # cache — so the compiled D/G steps carry no FlowNet2
+            # params. flow_cache.enabled: false keeps the reference's
+            # in-graph teacher.
+            from imaginaire_tpu.flow.cache import (
+                TeacherFlowCache,
+                flow_cache_settings,
+                resolve_cache_dir,
+            )
+
+            settings = flow_cache_settings(cfg)
+            if settings.enabled:
+                self.flow_cache = TeacherFlowCache(
+                    self.flow_net_wrapper, settings,
+                    cache_dir=resolve_cache_dir(cfg))
         self.num_temporal_scales = cfg_get(
             cfg_get(cfg.dis, "temporal", {}) or {}, "num_scales", 0)
         for s in range(self.num_temporal_scales):
@@ -134,7 +161,10 @@ class Trainer(BaseTrainer):
         params = {}
         if self.perceptual is not None:
             params["perceptual"] = self.perceptual.init_params(key)
-        if self.flow_net_wrapper is not None:
+        if self.flow_net_wrapper is not None and self.flow_cache is None:
+            # with the flow cache active the teacher runs off-step and
+            # its 162M-param tree must NOT enter the step programs —
+            # the gen executable shrinks and never re-ships the cascade
             params["flownet"] = self.flow_net_wrapper.params
         return params
 
@@ -142,7 +172,21 @@ class Trainer(BaseTrainer):
 
     def _start_of_iteration(self, data, current_iteration):
         """DensePose preprocessing for pose datasets
-        (ref: trainers/vid2vid.py:206-233 pre_process)."""
+        (ref: trainers/vid2vid.py:206-233 pre_process), plus the
+        off-step teacher: under the device-prefetch pipeline this hook
+        runs in the producer thread, so the FlowNet2 forward overlaps
+        the main step and its (flow, conf) outputs ride the prefetch
+        queue as committed sharded arrays."""
+        if self.flow_cache is not None and current_iteration >= 0:
+            # eval/test sweeps (current_iteration == -1) never consume
+            # flow supervision — don't pay the teacher for them
+            data = self.flow_cache.attach(dict(data))
+        elif isinstance(data, dict) and "_flow_cache" in data:
+            # dataset-side payloads with no consumer (cache disabled at
+            # the trainer after the dataset attached them) must not
+            # reach the jit boundary
+            data = dict(data)
+            data.pop("_flow_cache")
         pose_cfg = cfg_get(self.cfg.data, "for_pose_dataset", None)
         if pose_cfg is not None and \
                 "pose_maps-densepose" in (cfg_get(self.cfg.data,
@@ -303,18 +347,30 @@ class Trainer(BaseTrainer):
                     loss_params["perceptual"],
                     out["fake_raw_images"] * fg, data_t["image"] * fg)
         if self.use_flow and out.get("warped_images") is not None:
+            cached_gt = data_t.get("flow_gt") is not None
             if self.flow_net_wrapper is not None and \
-                    data_t.get("real_prev_image") is not None:
+                    (cached_gt or
+                     data_t.get("real_prev_image") is not None):
                 from imaginaire_tpu.losses.flow import FlowLoss
 
-                fn_params = loss_params["flownet"]
-                flow_loss = FlowLoss(
-                    lambda a, b: self.flow_net_wrapper._flow_fn(
-                        fn_params, a, b),
-                    has_fg=self.has_fg)
-                l1, warp, mask_l = flow_loss(
-                    {"image": data_t["image"],
-                     "real_prev_image": data_t["real_prev_image"]}, out)
+                if cached_gt:
+                    # amortized teacher: (flow, conf) arrived with the
+                    # batch (flow/cache.py) — the step program contains
+                    # no FlowNet2 cascade
+                    flow_loss = FlowLoss(None, has_fg=self.has_fg)
+                    loss_data = {"image": data_t["image"],
+                                 "flow_gt": data_t["flow_gt"],
+                                 "conf_gt": data_t["conf_gt"]}
+                else:
+                    fn_params = loss_params["flownet"]
+                    flow_loss = FlowLoss(
+                        lambda a, b: self.flow_net_wrapper._flow_fn(
+                            fn_params, a, b),
+                        has_fg=self.has_fg)
+                    loss_data = {"image": data_t["image"],
+                                 "real_prev_image":
+                                     data_t["real_prev_image"]}
+                l1, warp, mask_l = flow_loss(loss_data, out)
                 losses["Flow_L1"] = l1
                 losses["Flow_Warp"] = warp
                 losses["Flow_Mask"] = mask_l
@@ -455,6 +511,11 @@ class Trainer(BaseTrainer):
         if t > 0 and data["images"].ndim == 5:
             # real previous frame for the FlowNet2 teacher's GT flow
             data_t["real_prev_image"] = data["images"][:, t - 1]
+            if data.get("flow_gt") is not None:
+                # amortized teacher output (flow/cache.py):
+                # flow_gt[:, t-1] supervises frame t against frame t-1
+                data_t["flow_gt"] = data["flow_gt"][:, t - 1]
+                data_t["conf_gt"] = data["conf_gt"][:, t - 1]
         return data_t
 
     def _past_stacks(self, past_real, past_fake):
@@ -502,6 +563,9 @@ class Trainer(BaseTrainer):
             data_t = dict(constants, label=xs["label"], image=xs["image"],
                           real_prev_image=xs["real_prev_image"],
                           prev_labels=prev_labels, prev_images=prev_images)
+            if "flow_gt" in xs:
+                data_t["flow_gt"] = xs["flow_gt"]
+                data_t["conf_gt"] = xs["conf_gt"]
             data_t["past_stacks"] = (
                 self._past_stacks(past_real, past_fake) if use_past else {})
             # per-frame health summaries are dropped inside the scan
@@ -573,7 +637,7 @@ class Trainer(BaseTrainer):
                                  data["label"][:, :1],
                                  data["images"][:, :1])
         rebuilt = {"label", "image", "prev_labels", "prev_images",
-                   "real_prev_image", "past_stacks"}
+                   "real_prev_image", "past_stacks", "flow_gt", "conf_gt"}
         rebuilt |= set(self._rollout_scan_constants(data))
         extra = sorted(k for k in probe
                        if not str(k).startswith("_") and k not in rebuilt)
@@ -595,6 +659,14 @@ class Trainer(BaseTrainer):
             return self._gen_update_rollout(data)
 
     def _gen_update_rollout(self, data):
+        if self.flow_cache is not None and isinstance(data, dict) \
+                and "flow_gt" not in data \
+                and getattr(data.get("images"), "ndim", 0) == 5:
+            # safety net for callers that skip start_of_iteration
+            # (direct gen_update in tests/benches): the amortized
+            # teacher must still supply the supervision the cached step
+            # program expects
+            data = self.flow_cache.attach(dict(data))
         data = numeric_only(data)
         seq_len = (data["images"].shape[1] if data["images"].ndim == 5
                    else 1)
@@ -651,6 +723,10 @@ class Trainer(BaseTrainer):
             tail = {"label": data["label"][:, t_steady:],
                     "image": data["images"][:, t_steady:],
                     "real_prev_image": data["images"][:, t_steady - 1:-1]}
+            if data.get("flow_gt") is not None:
+                # pair index t-1 supervises frame t
+                tail["flow_gt"] = data["flow_gt"][:, t_steady - 1:]
+                tail["conf_gt"] = data["conf_gt"][:, t_steady - 1:]
             buffers = (prev_labels, prev_images, past_real, past_fake)
             self.state, d_tail, g_tail = self._jit_rollout_tail(
                 self.state, buffers, tail, constants)
@@ -682,6 +758,14 @@ class Trainer(BaseTrainer):
         self._log_losses("dis_update", d_losses)
         self._log_losses("gen_update", g_losses)
         return g_losses
+
+    def _end_of_iteration(self, data, current_epoch, current_iteration):
+        """Flush the amortized-teacher stats into the meters (the
+        DevicePrefetcher drain_stats pattern): flow_cache/hit_rate and
+        flow_cache/compute_ms land beside the loss meters on
+        logging_iter, never a device sync."""
+        if self.flow_cache is not None:
+            self.write_data_meters(self.flow_cache.drain_stats())
 
     def _after_gen_frame(self, data_t, fake):
         """Hook after each frame's G step (wc-vid2vid colors its point

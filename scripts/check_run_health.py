@@ -96,6 +96,9 @@ def main(argv=None):
             "dg_ratio_breaches": health.get("dg_ratio_breaches", 0),
             "has_health_counters": health.get("has_health_counters",
                                               False),
+            # informational only — flow_cache/* counters never trip the
+            # gate (an amortized-teacher run is not unhealthy)
+            "flow_cache": summary.get("flow_cache") or {"present": False},
         }, indent=1, default=str))
     elif failures:
         for failure in failures:

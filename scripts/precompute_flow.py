@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Warm the on-disk teacher flow cache for a whole dataset, offline.
+
+Iterates every sequence of the config's train (or val) split, runs the
+frozen FlowNet2 teacher on each adjacent frame pair at the CANONICAL
+resolution (after the config's deterministic resize ops, before any
+random crop/flip — see ``flow/cache.py``), and writes the
+content-addressed ``(flow, conf)`` shards the training run's
+``flow_cache`` then hits from epoch 1: the teacher cost disappears from
+training entirely.
+
+Idempotent: already-present shards are skipped, so a second run is
+100% hits (the CI smoke test pins this). Random resize augmentations
+(random_resize_h_w_aspect / random_scale_limit) have no deterministic
+canonical resolution — the script refuses rather than warm a cache
+nothing will ever hit.
+
+Usage:
+    python scripts/precompute_flow.py --config configs/.../bf16.yaml
+    python scripts/precompute_flow.py --config ... --dir /data/flow \
+        --split train --limit 100 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def canonicalize_frames(frames, resize_ops, interp, normalize):
+    """Raw decoded frames -> (T, Hc, Wc, C) float32 teacher inputs,
+    bit-identical to the Augmentor's canonical capture (same _apply
+    chain, same normalize arithmetic as process_item)."""
+    from imaginaire_tpu.data.augment import Augmentor
+
+    out = []
+    for arr in frames:
+        arr = Augmentor._apply(np.asarray(arr), resize_ops, interp)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        was_uint8 = arr.dtype == np.uint8
+        arr = arr.astype(np.float32)
+        if was_uint8:
+            arr = arr / 255.0
+        if normalize:
+            arr = arr * 2.0 - 1.0
+        out.append(arr)
+    return np.stack(out, axis=0)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Precompute the FlowNet2 teacher flow cache")
+    ap.add_argument("--config", required=True)
+    ap.add_argument("--split", choices=("train", "val"), default="train")
+    ap.add_argument("--dir", default=None,
+                    help="cache directory (default: flow_cache.dir or "
+                         "<logdir>/flow_cache)")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="only the first N sequences")
+    ap.add_argument("--chunk", type=int, default=8,
+                    help="teacher batch size in frame pairs")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as one JSON line only")
+    args = ap.parse_args(argv)
+
+    from imaginaire_tpu.config import Config, cfg_get
+    from imaginaire_tpu.data.augment import _INTERP, \
+        deterministic_resize_chain
+    from imaginaire_tpu.flow import FlowNet
+    from imaginaire_tpu.flow.cache import (
+        FlowCacheStore,
+        flow_cache_settings,
+        pair_key,
+        resolve_cache_dir,
+        teacher_id,
+    )
+    from imaginaire_tpu.registry import resolve
+
+    cfg = Config(args.config)
+    if args.dir:
+        cfg.flow_cache.dir = args.dir
+    cache_dir = resolve_cache_dir(cfg)
+    if cache_dir is None:
+        print("precompute_flow: no cache directory resolves — pass --dir "
+              "or set flow_cache.dir / logdir in the config",
+              file=sys.stderr)
+        return 2
+    fn_cfg = cfg_get(cfg, "flow_network", None)
+    if fn_cfg is None:
+        print("precompute_flow: the config has no flow_network section "
+              "(no FlowNet2 teacher to amortize)", file=sys.stderr)
+        return 2
+
+    dataset = resolve(cfg.data.type, "Dataset")(
+        cfg, is_inference=(args.split == "val"))
+    if not hasattr(dataset, "sequences"):
+        print("precompute_flow: dataset type "
+              f"{cfg.data.type} has no frame sequences", file=sys.stderr)
+        return 2
+    image_type = dataset.input_image[0]
+    aug_cfg = dict(getattr(dataset.augmentor, "cfg", {}) or {})
+    first_root, first_seq, first_stems = dataset.sequences[0]
+    probe = dataset.backends[image_type][first_root].getitem(
+        f"{first_seq}/{first_stems[0]}")
+    resize_ops, canonical_hw, deterministic = deterministic_resize_chain(
+        aug_cfg, np.asarray(probe).shape[:2])
+    if not deterministic:
+        print("precompute_flow: the augmentation config draws a random "
+              "resize per sample (random_resize_h_w_aspect / "
+              "random_scale_limit) — there is no canonical resolution "
+              "to warm; drop those keys or use producer mode",
+              file=sys.stderr)
+        return 2
+
+    import jax
+
+    wrapper = FlowNet(
+        weights_path=cfg_get(fn_cfg, "weights_path", None),
+        allow_random_init=cfg_get(fn_cfg, "allow_random_init", False))
+    wrapper.init_params(jax.random.PRNGKey(0))
+    teacher = teacher_id(wrapper.weights_path)
+    store = FlowCacheStore(cache_dir,
+                           flow_cache_settings(cfg).store_dtype)
+    interp = _INTERP.get(dataset.interpolators.get(image_type))
+    normalize = dataset.normalize.get(image_type, False)
+
+    t0 = time.time()
+    hits = misses = 0
+    sequences = dataset.sequences[:args.limit] \
+        if args.limit else dataset.sequences
+    for root_idx, seq, stems in sequences:
+        todo = []  # (pair_index, key)
+        for p in range(len(stems) - 1):
+            key = pair_key(dataset.name, root_idx, seq, stems[p + 1],
+                           stems[p], canonical_hw, teacher)
+            if store.has(key):
+                hits += 1
+            else:
+                todo.append((p, key))
+        if not todo:
+            continue
+        misses += len(todo)
+        backend = dataset.backends[image_type][root_idx]
+        needed = sorted({stems[p] for p, _ in todo}
+                        | {stems[p + 1] for p, _ in todo})
+        raw = {s: backend.getitem(f"{seq}/{s}") for s in needed}
+        canon = {s: f for s, f in zip(needed, canonicalize_frames(
+            [raw[s] for s in needed], resize_ops, interp, normalize))}
+        for start in range(0, len(todo), max(args.chunk, 1)):
+            chunk = todo[start:start + max(args.chunk, 1)]
+            im_a = np.stack([canon[stems[p + 1]] for p, _ in chunk])
+            im_b = np.stack([canon[stems[p]] for p, _ in chunk])
+            flow, conf = wrapper._jit_flow(wrapper.params, im_a, im_b)
+            flow = np.asarray(flow, np.float32)
+            conf = np.asarray(conf, np.float32)
+            for j, (_, key) in enumerate(chunk):
+                store.put(key, flow[j], conf[j])
+
+    total = hits + misses
+    summary = {
+        "dir": cache_dir,
+        "sequences": len(sequences),
+        "pairs": total,
+        "hits": hits,
+        "misses": misses,
+        "hit_rate": round(hits / total, 4) if total else 0.0,
+        "canonical_hw": list(canonical_hw),
+        "duration_s": round(time.time() - t0, 3),
+    }
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(f"precompute_flow: {total} pairs at "
+              f"{canonical_hw[0]}x{canonical_hw[1]} -> {cache_dir} "
+              f"({hits} already cached, {misses} computed, "
+              f"{summary['duration_s']}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,499 @@
+"""Teacher flow cache (flow/cache.py, ISSUE 4): off-step FlowNet2
+execution, content-addressed on-disk caching at canonical resolution,
+equivariant crop/hflip transforms, step programs free of the teacher
+param tree, and the precompute CLI + health-gate satellites."""
+
+import io
+import json
+import contextlib
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import yaml
+
+from imaginaire_tpu.config import Config
+from imaginaire_tpu.flow.cache import (
+    FlowCacheStore,
+    TeacherFlowCache,
+    content_key,
+    flow_cache_settings,
+    pair_key,
+    transform_flow,
+)
+from imaginaire_tpu.registry import resolve
+
+CFG = os.path.join(os.path.dirname(__file__), "..", "configs", "unit_test",
+                   "vid2vid_street.yaml")
+
+
+def video_batch(rng, t=3, h=64, w=64, labels=12):
+    return {
+        "images": np.asarray(rng.rand(1, t, h, w, 3),
+                             np.float32) * 2 - 1,
+        "label": (rng.rand(1, t, h, w, labels) > 0.9).astype(np.float32),
+    }
+
+
+def make_cfg(tmp_path, cache=None, shrink_perceptual=True):
+    cfg = Config(CFG)
+    cfg.logdir = str(tmp_path)
+    cfg.flow_network = {"allow_random_init": True}
+    if cache is not None:
+        cfg.flow_cache = dict(cache)
+    if shrink_perceptual:
+        # equivalence, not capacity (the TestRolloutScan convention)
+        cfg.trainer.perceptual_loss.layers = ["relu_1_1", "relu_2_1"]
+        cfg.trainer.perceptual_loss.weights = [0.5, 1.0]
+    return cfg
+
+
+# --------------------------------------------------------------- store
+
+
+class TestStoreAndKeys:
+    def test_roundtrip_and_stats(self, rng, tmp_path):
+        store = FlowCacheStore(str(tmp_path), "float32")
+        flow = rng.rand(8, 8, 2).astype(np.float32) * 40 - 20
+        conf = (rng.rand(8, 8, 1) > 0.5).astype(np.float32)
+        key = pair_key("d", 0, "seq", "b", "a", (8, 8), "t")
+        assert store.get(key) is None
+        store.put(key, flow, conf)
+        flow2, conf2 = store.get(key)
+        np.testing.assert_array_equal(flow2, flow)
+        np.testing.assert_array_equal(conf2, conf)
+        assert store.stats() == {"hits": 1, "misses": 1, "hit_rate": 0.5}
+
+    def test_float16_storage_tolerance(self, rng, tmp_path):
+        store = FlowCacheStore(str(tmp_path), "float16")
+        flow = rng.rand(8, 8, 2).astype(np.float32) * 40 - 20
+        conf = np.ones((8, 8, 1), np.float32)
+        key = pair_key("d", 0, "seq", "b", "a", (8, 8), "t")
+        store.put(key, flow, conf)
+        flow2, _ = store.get(key)
+        # |flow| <= 40 px -> float16 quantization < 0.05 px
+        np.testing.assert_allclose(flow2, flow, atol=0.05)
+
+    def test_key_invalidation(self):
+        base = pair_key("d", 0, "seq", "f1", "f0", (64, 64), "t1")
+        # resolution change invalidates
+        assert base != pair_key("d", 0, "seq", "f1", "f0", (128, 64), "t1")
+        # teacher-weights change invalidates
+        assert base != pair_key("d", 0, "seq", "f1", "f0", (64, 64), "t2")
+        # different frame pair / sequence / root
+        assert base != pair_key("d", 0, "seq", "f2", "f1", (64, 64), "t1")
+        assert base != pair_key("d", 1, "seq", "f1", "f0", (64, 64), "t1")
+        # the key is CANONICAL: crop/flip draws do not enter it — that is
+        # the whole point of the equivariant transform
+        assert base == pair_key("d", 0, "seq", "f1", "f0", (64, 64), "t1")
+
+    def test_content_key_tracks_bytes(self, rng):
+        a = rng.rand(1, 3, 8, 8, 3).astype(np.float32)
+        b = a.copy()
+        b[0, 0, 0, 0, 0] += 1e-3
+        assert content_key(a, "t") == content_key(a.copy(), "t")
+        assert content_key(a, "t") != content_key(b, "t")
+        assert content_key(a, "t") != content_key(a, "t2")
+
+    def test_corrupt_shard_degrades_to_miss(self, rng, tmp_path):
+        store = FlowCacheStore(str(tmp_path), "float32")
+        key = pair_key("d", 0, "seq", "b", "a", (8, 8), "t")
+        path = store.path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(b"not an npz")
+        assert store.get(key) is None
+
+
+# ----------------------------------------------------------- transform
+
+
+class TestTransform:
+    def test_hflip_oracle(self, rng):
+        flow = rng.rand(2, 6, 8, 2).astype(np.float32) * 10 - 5
+        conf = rng.rand(2, 6, 8, 1).astype(np.float32)
+        tf, tc = transform_flow(flow, conf, {"hflip": True, "crop": None})
+        h, w = 6, 8
+        for y in range(h):
+            for x in range(w):
+                np.testing.assert_allclose(
+                    tf[:, y, x, 0], -flow[:, y, w - 1 - x, 0])
+                np.testing.assert_allclose(
+                    tf[:, y, x, 1], flow[:, y, w - 1 - x, 1])
+                np.testing.assert_allclose(
+                    tc[:, y, x, 0], conf[:, y, w - 1 - x, 0])
+
+    def test_crop_is_pure_slice(self, rng):
+        flow = rng.rand(2, 6, 8, 2).astype(np.float32)
+        conf = rng.rand(2, 6, 8, 1).astype(np.float32)
+        tf, tc = transform_flow(flow, conf,
+                                {"crop": (1, 2, 4, 5), "hflip": False})
+        np.testing.assert_array_equal(tf, flow[:, 1:5, 2:7])
+        np.testing.assert_array_equal(tc, conf[:, 1:5, 2:7])
+
+    def test_crop_then_flip_order(self, rng):
+        flow = rng.rand(1, 6, 8, 2).astype(np.float32)
+        conf = rng.rand(1, 6, 8, 1).astype(np.float32)
+        tf, _ = transform_flow(flow, conf,
+                               {"crop": (0, 1, 4, 5), "hflip": True})
+        manual = flow[:, 0:4, 1:6][:, :, ::-1] * np.asarray([-1.0, 1.0])
+        np.testing.assert_allclose(tf, manual)
+
+
+# ------------------------------------------- equivariance (toy teacher)
+
+
+def toy_flow(im_a, im_b, radius=2):
+    """Brute-force integer block matcher: per-pixel shift minimizing the
+    3x3-summed SSD (wrap borders). A real — if crude — flow estimator
+    that is exactly flip- and (interior-)crop-equivariant, so the cache
+    transform can be pinned without CNN non-equivariance noise."""
+    cost_best = np.full(im_a.shape[:2], np.inf)
+    flow = np.zeros(im_a.shape[:2] + (2,), np.float32)
+    for dv in range(-radius, radius + 1):
+        for du in range(-radius, radius + 1):
+            # flow convention: value (du, dv) at x means the match in
+            # im_b sits at x - (du, dv)
+            shifted = np.roll(im_b, (dv, du), axis=(0, 1))
+            d = ((im_a.astype(np.float64) - shifted) ** 2).sum(-1)
+            s = sum(np.roll(d, (i, j), axis=(0, 1))
+                    for i in (-1, 0, 1) for j in (-1, 0, 1))
+            m = s < cost_best
+            cost_best = np.where(m, s, cost_best)
+            flow[m] = (du, dv)
+    return flow, np.ones(im_a.shape[:2] + (1,), np.float32)
+
+
+class ToyWrapper:
+    """Duck-typed FlowNet stand-in for TeacherFlowCache."""
+
+    params = None
+    weights_path = None
+
+    def _jit_flow(self, params, im_a, im_b):
+        flows = np.stack([toy_flow(a, b)[0] for a, b in zip(im_a, im_b)])
+        confs = np.ones(flows.shape[:-1] + (1,), np.float32)
+        return flows, confs
+
+
+class TestEquivariance:
+    """Cached-and-transformed (flow, conf) vs the teacher run directly
+    on the augmented frames: exact for hflip, boundary-band tolerance
+    for crop (the matcher wraps at borders, real flow estimators lose
+    context there the same way)."""
+
+    RADIUS = 2
+    BAND = RADIUS + 2  # search radius + box window
+
+    def _pair(self, rng, h=24, w=32, shift=(2, -1)):
+        a = rng.rand(h, w, 3).astype(np.float32)
+        b = np.roll(a, (shift[1], shift[0]), axis=(0, 1))  # (dv, du)
+        return a, b
+
+    def _run_cache(self, metas, images, tmp_path):
+        cache = TeacherFlowCache(
+            ToyWrapper(),
+            flow_cache_settings({"flow_cache": {
+                "enabled": True, "mode": "disk",
+                "store_dtype": "float32"}}),
+            cache_dir=str(tmp_path / "store"))
+        batch = cache.attach({"images": images, "_flow_cache": metas})
+        return cache, batch["flow_gt"], batch["conf_gt"]
+
+    def test_hflip_exact(self, rng, tmp_path):
+        a, b = self._pair(rng)
+        h, w = a.shape[:2]
+        record = {"canonical_hw": (h, w), "crop": None, "hflip": True,
+                  "canonical_ok": True}
+        keys = [pair_key("toy", 0, "s", "f1", "f0", (h, w), "toy")]
+        # augmented = flipped canonical; teacher pair order is
+        # (target=frame1, prev=frame0) -> src order [b(prev), a... ]:
+        # frames are [f0, f1] = [b_prev, a_tgt]? use [a0, a1] = (b, a)
+        src = np.stack([b, a])  # frames f0, f1
+        aug = src[:, :, ::-1]  # hflip
+        images = aug[None]  # (1, 2, h, w, 3)
+        _, flow_gt, conf_gt = self._run_cache(
+            [{"record": record, "keys": keys, "src": src}], images,
+            tmp_path)
+        direct, _ = toy_flow(aug[1], aug[0], self.RADIUS)
+        np.testing.assert_array_equal(flow_gt[0, 0], direct)
+
+    def test_crop_interior_exact(self, rng, tmp_path):
+        a, b = self._pair(rng)
+        h, w = a.shape[:2]
+        top, left, ch, cw = 3, 5, 16, 20
+        record = {"canonical_hw": (h, w),
+                  "crop": (top, left, ch, cw), "hflip": False,
+                  "canonical_ok": True}
+        keys = [pair_key("toy", 0, "s", "f1", "f0", (h, w), "toy")]
+        src = np.stack([b, a])
+        aug = src[:, top:top + ch, left:left + cw]
+        images = aug[None]
+        _, flow_gt, _ = self._run_cache(
+            [{"record": record, "keys": keys, "src": src}], images,
+            tmp_path)
+        direct, _ = toy_flow(aug[1], aug[0], self.RADIUS)
+        band = self.BAND
+        np.testing.assert_array_equal(
+            flow_gt[0, 0, band:-band, band:-band],
+            direct[band:-band, band:-band])
+
+    def test_store_hit_path_matches_fresh_compute(self, rng, tmp_path):
+        """Second epoch: the dataset loads the canonical shards and the
+        producer only transforms — identical supervision, hit_rate 1."""
+        a, b = self._pair(rng)
+        h, w = a.shape[:2]
+        record = {"canonical_hw": (h, w), "crop": (1, 2, 16, 20),
+                  "hflip": True, "canonical_ok": True}
+        keys = [pair_key("toy", 0, "s", "f1", "f0", (h, w), "toy")]
+        src = np.stack([b, a])
+        aug = src[:, 1:17, 2:22][:, :, ::-1]
+        images = aug[None]
+        cache, flow_1, conf_1 = self._run_cache(
+            [{"record": record, "keys": keys, "src": src}], images,
+            tmp_path)
+        assert cache.hit_rate() == 0.0  # cold epoch: all misses
+        # warm epoch: the dataset-side hook would load the shards
+        cached = [cache.store.get(k) for k in keys]
+        assert all(c is not None for c in cached)
+        payload = {"record": record, "keys": keys,
+                   "flow": np.stack([c[0] for c in cached]),
+                   "conf": np.stack([c[1] for c in cached])}
+        batch = cache.attach({"images": images, "_flow_cache": [payload]})
+        np.testing.assert_array_equal(batch["flow_gt"], flow_1)
+        np.testing.assert_array_equal(batch["conf_gt"], conf_1)
+        assert cache.hit_rate() == 0.5  # 1 miss epoch + 1 hit epoch
+
+
+# -------------------------------------------- real teacher, content path
+
+
+class TestAttachContentPath:
+    def test_matches_in_graph_teacher_and_hits_disk(self, rng, tmp_path):
+        from imaginaire_tpu.flow import FlowNet
+
+        wrapper = FlowNet(allow_random_init=True)
+        wrapper.init_params(jax.random.PRNGKey(0))
+        cache = TeacherFlowCache(
+            wrapper,
+            flow_cache_settings({"flow_cache": {
+                "enabled": True, "mode": "disk",
+                "store_dtype": "float32"}}),
+            cache_dir=str(tmp_path / "store"))
+        data = video_batch(rng)
+        batch = cache.attach(dict(data))
+        assert batch["flow_gt"].shape == (1, 2, 64, 64, 2)
+        assert batch["conf_gt"].shape == (1, 2, 64, 64, 1)
+        # byte-tolerance equivalence vs the in-graph teacher: the same
+        # jitted function on the same (target, prev) pair ordering
+        images = data["images"]
+        im_a = images[:, 1:].reshape((-1, 64, 64, 3))
+        im_b = images[:, :-1].reshape((-1, 64, 64, 3))
+        f, c = wrapper._jit_flow(wrapper.params, jnp.asarray(im_a),
+                                 jnp.asarray(im_b))
+        np.testing.assert_array_equal(
+            batch["flow_gt"].reshape(-1, 64, 64, 2), np.asarray(f))
+        np.testing.assert_array_equal(
+            batch["conf_gt"].reshape(-1, 64, 64, 1), np.asarray(c))
+        assert cache.hit_rate() == 0.0
+        # identical bytes -> whole-batch disk hit, exact at float32
+        batch2 = cache.attach(dict(data))
+        np.testing.assert_array_equal(batch2["flow_gt"], batch["flow_gt"])
+        assert cache.hit_rate() == 0.5
+
+    def test_non_video_batches_pass_through(self, rng):
+        cache = TeacherFlowCache(ToyWrapper(),
+                                 flow_cache_settings(
+                                     {"flow_cache": {"enabled": True,
+                                                     "mode": "producer"}}))
+        image_batch = {"images": rng.rand(2, 8, 8, 3).astype(np.float32)}
+        out = cache.attach(dict(image_batch))
+        assert "flow_gt" not in out
+        single = {"images": rng.rand(1, 1, 8, 8, 3).astype(np.float32),
+                  "_flow_cache": [{}]}
+        out = cache.attach(dict(single))
+        assert "flow_gt" not in out and "_flow_cache" not in out
+
+
+# --------------------------------------------------- trainer integration
+
+
+class TestTrainerParamTree:
+    def test_step_param_tree_loses_flownet(self, tmp_path):
+        """The acceptance assertion: with flow_cache.enabled the step
+        programs' input tree (state['loss_params']) carries no FlowNet2
+        parameters; the in-graph fallback still does."""
+        cfg = make_cfg(tmp_path, cache={"enabled": True,
+                                        "mode": "producer"})
+        cfg.trainer.perceptual_loss = None  # keep this test cheap
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        assert trainer.flow_cache is not None
+        params = trainer.init_loss_params(jax.random.PRNGKey(0))
+        assert "flownet" not in params
+
+        cfg2 = make_cfg(tmp_path, cache={"enabled": False})
+        cfg2.trainer.perceptual_loss = None
+        trainer2 = resolve(cfg2.trainer.type, "Trainer")(cfg2)
+        assert trainer2.flow_cache is None
+        params2 = trainer2.init_loss_params(jax.random.PRNGKey(0))
+        assert "flownet" in params2
+
+    def test_disabled_cache_pops_stray_payloads(self, rng, tmp_path):
+        cfg = make_cfg(tmp_path, cache={"enabled": False})
+        cfg.trainer.perceptual_loss = None
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        data = dict(video_batch(rng), _flow_cache=[{"record": {}}])
+        out = trainer._start_of_iteration(data, 1)
+        assert "_flow_cache" not in out
+
+
+@pytest.mark.slow
+class TestCachedRollout:
+    def _run(self, tmp_path, cache):
+        cfg = make_cfg(tmp_path / ("cache" if cache else "graph"),
+                       cache={"enabled": cache, "mode": "disk",
+                              "dir": str(tmp_path / "store"),
+                              "store_dtype": "float32"})
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        data = video_batch(np.random.RandomState(7))
+        batch = trainer.start_of_iteration(dict(data), 1)
+        trainer.init_state(jax.random.PRNGKey(0), batch)
+        losses = trainer.gen_update(batch)
+        leaf = jax.tree_util.tree_leaves(
+            trainer.state["vars_G"]["params"])[0]
+        return (trainer,
+                {k: float(jax.device_get(v)) for k, v in losses.items()},
+                np.asarray(jax.device_get(leaf)))
+
+    def test_cached_rollout_matches_in_graph(self, tmp_path):
+        """Full-step equivalence: amortized teacher vs in-graph teacher,
+        same data + same seeds -> same losses and same updated params."""
+        t_graph, losses_g, leaf_g = self._run(tmp_path, False)
+        t_cache, losses_c, leaf_c = self._run(tmp_path, True)
+        assert "flownet" in t_graph.state["loss_params"]
+        assert "flownet" not in t_cache.state["loss_params"]
+        assert set(losses_g) == set(losses_c)
+        for k in losses_g:
+            np.testing.assert_allclose(losses_c[k], losses_g[k],
+                                       rtol=2e-3, atol=2e-4, err_msg=k)
+        np.testing.assert_allclose(leaf_c, leaf_g, rtol=2e-3, atol=2e-4)
+
+    def test_prefetched_batches_carry_flow_gt(self, tmp_path):
+        """DevicePrefetcher producer thread runs the teacher: batches
+        arrive as PrefetchedBatch with (flow, conf) already attached —
+        the step loop never touches the teacher."""
+        from imaginaire_tpu.data.device_prefetch import (
+            DevicePrefetcher,
+            PrefetchedBatch,
+        )
+
+        cfg = make_cfg(tmp_path, cache={"enabled": True,
+                                        "mode": "producer"})
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        rng = np.random.RandomState(7)
+        loader = [video_batch(rng) for _ in range(2)]
+        prefetcher = DevicePrefetcher(
+            loader,
+            host_preprocess=lambda b, i: trainer._start_of_iteration(b, i))
+        batches = list(prefetcher)
+        assert len(batches) == 2
+        for batch in batches:
+            assert isinstance(batch, PrefetchedBatch)
+            assert batch["flow_gt"].shape == (1, 2, 64, 64, 2)
+        # consuming a prefetched batch runs the cached-supervision step
+        batch = trainer.start_of_iteration(batches[0], 1)
+        trainer.init_state(jax.random.PRNGKey(0), batch)
+        losses = trainer.gen_update(batch)
+        assert "Flow_L1" in losses
+        for k, v in losses.items():
+            assert np.isfinite(float(jax.device_get(v))), k
+
+
+# ------------------------------------------- dataset + precompute + gate
+
+
+class TestPrecomputeAndDataset:
+    def _overlay(self, tmp_path):
+        with open(CFG) as f:
+            user = yaml.safe_load(f)
+        user["flow_network"] = {"allow_random_init": True}
+        user["flow_cache"] = {"enabled": True,
+                              "dir": str(tmp_path / "store"),
+                              "store_dtype": "float32"}
+        path = str(tmp_path / "cfg.yaml")
+        with open(path, "w") as f:
+            yaml.safe_dump(user, f)
+        return path
+
+    def _precompute(self, cfg_path):
+        from scripts.precompute_flow import main
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = main(["--config", cfg_path, "--json"])
+        return rc, json.loads(buf.getvalue().strip().splitlines()[-1])
+
+    def test_precompute_smoke_second_run_all_hits(self, tmp_path):
+        cfg_path = self._overlay(tmp_path)
+        rc, s1 = self._precompute(cfg_path)
+        assert rc == 0
+        assert s1["pairs"] == 2 and s1["misses"] == 2  # 3 fixture frames
+        rc, s2 = self._precompute(cfg_path)
+        assert rc == 0
+        assert s2["hit_rate"] == 1.0 and s2["misses"] == 0
+
+        # the warmed store serves the dataset hook: items carry the
+        # canonical (flow, conf), zero teacher cost at train time
+        cfg = Config(cfg_path)
+        ds = resolve(cfg.data.type, "Dataset")(cfg)
+        assert ds._flow_hook is not None and ds._flow_hook.active
+        item = ds[0]
+        payload = item["_flow_cache"]
+        assert payload["flow"] is not None
+        assert payload["flow"].shape == (2, 64, 64, 2)
+        assert payload["record"]["canonical_hw"] == (64, 64)
+
+    def test_dataset_miss_ships_canonical_src(self, tmp_path):
+        cfg = Config(self._overlay(tmp_path))  # store never warmed
+        ds = resolve(cfg.data.type, "Dataset")(cfg)
+        item = ds[0]
+        payload = item["_flow_cache"]
+        assert payload.get("flow") is None
+        assert payload["src"].shape == (3, 64, 64, 3)
+        # teacher-input range: the fixture images are normalize: True
+        assert payload["src"].min() >= -1.0 and payload["src"].max() <= 1.0
+
+    def test_inference_items_carry_no_payload(self, tmp_path):
+        cfg = Config(self._overlay(tmp_path))
+        ds = resolve(cfg.data.type, "Dataset")(cfg, is_inference=True)
+        assert ds._flow_hook is None
+
+    def test_health_gate_accepts_flow_cache_counters(self, tmp_path):
+        """The CI gate must treat flow_cache/* counters as benign (and
+        surface them), with or without --require-health."""
+        from scripts.check_run_health import main
+
+        run_dir = tmp_path / "run"
+        os.makedirs(run_dir)
+        events = [
+            {"kind": "counter", "name": "health/G/grad_norm/_total",
+             "value": 1.0, "step": 10, "t": 1.0},
+            {"kind": "counter", "name": "flow_cache/hit_rate",
+             "value": 1.0, "step": 10, "t": 1.0},
+            {"kind": "counter", "name": "flow_cache/compute_ms",
+             "value": 5.0, "step": 10, "t": 1.0},
+        ]
+        with open(run_dir / "telemetry.jsonl", "w") as f:
+            for ev in events:
+                f.write(json.dumps(ev) + "\n")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = main([str(run_dir), "--require-health", "--json"])
+        assert rc == 0, buf.getvalue()
+        verdict = json.loads(buf.getvalue())
+        assert verdict["healthy"]
+        assert verdict["flow_cache"]["present"]
+        assert verdict["flow_cache"]["hit_rate"] == 1.0
+        assert verdict["flow_cache"]["compute_ms_mean"] == 5.0
