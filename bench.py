@@ -46,15 +46,38 @@ def _bench_telemetry():
                                flush_every_n_steps=0, mfu=False)
 
 
-def _leg_summary(tm):
-    """Slim window_summary for the bench JSON sidecars."""
+def _leg_summary(tm, xla_mark=None):
+    """Slim window_summary for the bench JSON sidecars. With an
+    ``xla_mark`` (a ledger snapshot from the leg's start), the summary
+    also carries the leg's compile cost, recompile count, and the peak
+    HBM watermark (ISSUE 5: every bench leg answers 'what did compiles
+    cost and did anything re-specialize')."""
     s = tm.window_summary()
     keep = ("duration_s", "steps", "step_ms_p50", "step_ms_p99",
             "data_wait_share_pct", "imgs_per_sec")
     out = {k: s[k] for k in keep if k in s}
     out["phase_total_ms"] = {name: row["total_ms"]
                              for name, row in s.get("phases", {}).items()}
+    if xla_mark is not None:
+        out["xla"] = _xla_leg(xla_mark)
     return out
+
+
+def _xla_mark():
+    """Ledger snapshot at a bench leg's start (before its compiles)."""
+    from imaginaire_tpu.telemetry import xla_obs
+
+    return xla_obs.ledger().snapshot()
+
+
+def _xla_leg(mark):
+    """{compiles, compile_s, recompile_count, cache_hits,
+    peak_hbm_bytes} for one leg (peak_hbm_bytes is None on CPU)."""
+    from imaginaire_tpu.telemetry import xla_obs
+
+    delta = xla_obs.snapshot_delta(mark)
+    delta["recompile_count"] = delta.pop("recompiles")
+    return delta
 ZOO_CONFIG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "configs", "projects", "spade", "cocostuff",
                           "base128_bs4.yaml")
@@ -331,6 +354,7 @@ def run_vid2vid(seq_len=4):
             trainer = data = None
             jax.clear_caches()
             trainer, label_ch = build_vid2vid(flow_teacher, hw)
+            xla_mark = _xla_mark()
             data = jax.device_put(jax.tree_util.tree_map(
                 np.asarray,
                 vid2vid_batch(bs, seq_len, label_ch, h=hw[0], w=hw[1])))
@@ -359,7 +383,7 @@ def run_vid2vid(seq_len=4):
                 tm.step_complete(i, items=bs * seq_len)
             sync()
             dt = time.time() - t0
-            leg_telemetry = _leg_summary(tm)
+            leg_telemetry = _leg_summary(tm, xla_mark)
             frames_per_sec = bs * seq_len * iters / dt
             # same recipe with the whole-rollout scan tail
             # (trainer.rollout_scan) for the head-to-head record;
@@ -776,6 +800,7 @@ def _pipeline_ab(cfg, iters=10):
     bs = int(cfg.data.train.batch_size)
     label_ch = get_paired_input_label_channel_number(cfg.data)
     trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+    xla_mark = _xla_mark()  # all three feed legs share one program set
     train_loader, _ = get_train_and_val_dataloader(cfg)
     cycler = _EpochCycler(train_loader)
 
@@ -859,6 +884,9 @@ def _pipeline_ab(cfg, iters=10):
         # telemetry.jsonl carries (ISSUE 2 satellite)
         "leg_telemetry": {"sync": sync_tm, "prefetch": prefetch_tm,
                           "synthetic": synth_tm},
+        # compile ledger totals for the whole A/B (one shared program
+        # set; ISSUE 5) — recompile_count past warmup should be 0
+        "xla": _xla_leg(xla_mark),
     }
 
 
@@ -923,6 +951,7 @@ def run(trainer, label_ch, batch_sizes, metric):
     last_error = None
     for bs in batch_sizes:
         try:
+            xla_mark = _xla_mark()
             # commit the batch to device once: steady-state throughput is
             # measured on-device (in real training the device prefetcher
             # overlaps H2D with the step; see data/device_prefetch.py
@@ -965,6 +994,9 @@ def run(trainer, label_ch, batch_sizes, metric):
                 "value": round(imgs_per_sec, 3),
                 "unit": "imgs/sec/chip",
                 "vs_baseline": round(imgs_per_sec / V100_IMGS_PER_SEC, 3),
+                # per-leg compile cost + recompile tripwire + peak HBM
+                # (ISSUE 5); recompile_count must stay 0 post-warmup
+                "xla": _xla_leg(xla_mark),
             }))
             return
         except Exception as e:  # OOM etc. -> halve batch

@@ -229,6 +229,33 @@ def default_config():
             # FID/KID eval sweeps complete no training steps by design)
             watchdog_exempt_spans=["eval"],
         ),
+        # -- XLA compile ledger + device-memory observability
+        # (telemetry/xla_obs.py): every labeled program (dis_step /
+        # gen_step, vid2vid per-frame programs, flow teacher, inception
+        # extractor) compiles through a ledger that records lowering/
+        # compile time, memory_analysis (temp/argument/output bytes)
+        # and cost_analysis FLOPs into xla/compile/* counters plus
+        # logs/<run>/compile_ledger.jsonl; a recompile tripwire
+        # fingerprints (shapes, dtypes, shardings) per program and any
+        # post-warmup recompile logs a structural diff naming the
+        # changed leaf + increments xla/recompiles (raise instead under
+        # strict_recompile; expected_recompiles allowlists labels whose
+        # re-jits are legitimate). mem_sample adds per-device
+        # memory_stats() watermarks (mem/<dev>/*) on the telemetry
+        # flush cadence (no-op on CPU), and a RESOURCE_EXHAUSTED
+        # escaping a ledgered program dumps logs/<run>/oom_report.json
+        # (watermark history, live-array census, per-executable
+        # footprints) before re-raising.
+        xla_obs=AttrDict(
+            enabled=True,
+            strict_recompile=False,
+            expected_recompiles=[],  # labels whose re-jits never count
+            ledger_file=True,  # write logs/<run>/compile_ledger.jsonl
+            mem_sample=True,  # HBM watermarks on the flush cadence
+            mem_budget_frac=0.9,  # check_run_health watermark gate
+            census_top=20,  # live-array census rows kept in reports
+            oom_report=True,  # RESOURCE_EXHAUSTED forensics dump
+        ),
         # -- training-health diagnostics (diagnostics/): in-step norm
         # auditing (per-module grad/param norms, update/param ratio,
         # spectral-norm sigma, EMA drift) computed INSIDE the jitted D/G

@@ -201,12 +201,19 @@ def load_params(path=None, random_init=False, input_shape=(1, 299, 299, 3)):
 
 
 def make_extractor(variables, compute_dtype=jnp.bfloat16):
-    """Jitted (B,299,299,3) imagenet-normalized images -> (B,2048) fp32."""
+    """Jitted (B,299,299,3) imagenet-normalized images -> (B,2048) fp32.
+
+    Compiles through the ledger (``telemetry/xla_obs.py``) so FID/KID
+    sweeps account their compile time and executable footprint like the
+    step programs; allow_shape_growth — the tail batch of a sweep is
+    legitimately smaller."""
+    from imaginaire_tpu.telemetry import xla_obs
+
     model = InceptionV3()
 
-    @jax.jit
     def run(images):
         feats = model.apply(variables, images.astype(compute_dtype))
         return feats.astype(jnp.float32)
 
-    return run
+    return xla_obs.compiled_program("inception_extractor", run,
+                                    allow_shape_growth=True)

@@ -35,7 +35,15 @@ class FlowNet:
         self.params = None
         self.weights_path = weights_path or DEFAULT_WEIGHTS
         self.allow_random_init = allow_random_init
-        self._jit_flow = jax.jit(self._flow_fn)
+        # the teacher compiles through the ledger (it runs in the
+        # prefetcher producer thread under flow_cache — a watchdog dump
+        # during its multi-minute cold compile should say so);
+        # allow_shape_growth: one executable per input resolution is by
+        # design, not a recompile storm
+        from imaginaire_tpu.telemetry import xla_obs
+
+        self._jit_flow = xla_obs.compiled_program(
+            "flow_teacher", self._flow_fn, allow_shape_growth=True)
 
     def init_params(self, key, image_shape=(1, 64, 64, 3)):
         if os.path.exists(self.weights_path):

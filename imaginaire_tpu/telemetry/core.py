@@ -163,6 +163,10 @@ class Telemetry:
 
         self._lock = threading.RLock()
         self._local = threading.local()
+        # flush-cadence callbacks (tm, step) — xla_obs installs its
+        # ledger-counter + HBM-watermark sampler here so memory is
+        # sampled exactly when the window is fenced anyway
+        self.flush_hooks = []
         self._events = []
         self._clock = time.monotonic
         self._ring = deque(maxlen=self.ring_size)
@@ -397,6 +401,12 @@ class Telemetry:
             self.counter("perf/device_drain_ms",
                          (time.perf_counter() - t0) * 1e3, step=step)
             self.last_heartbeat = self._clock()
+        for hook in list(self.flush_hooks):
+            try:
+                hook(self, step)
+            except Exception as e:  # noqa: BLE001 — hooks never kill runs
+                logger.warning("telemetry flush hook %s failed: %s",
+                               getattr(hook, "__name__", hook), e)
         now = self._clock()
         with self._lock:
             stats = self._stat_counters(now)
@@ -583,6 +593,15 @@ def configure(cfg=None, logdir=None, **overrides):
         sinks = make_sinks(sinks, settings.get("logdir"))
     old, _TELEMETRY = _TELEMETRY, Telemetry(sinks=sinks, **settings)
     old.shutdown()
+    # XLA observability (xla_obs.py) rides the same configure call:
+    # adopt cfg.xla_obs, replay compiles that predate this instance
+    # into its sinks, and install the flush-cadence memory sampler
+    try:
+        from imaginaire_tpu.telemetry import xla_obs
+
+        xla_obs.on_telemetry_configured(cfg, _TELEMETRY)
+    except Exception as e:  # noqa: BLE001 — observability is best-effort
+        logger.warning("xla_obs configure failed: %s", e)
     if not _ATEXIT_REGISTERED:
         atexit.register(lambda: _TELEMETRY.shutdown())
         _ATEXIT_REGISTERED = True

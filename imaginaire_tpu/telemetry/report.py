@@ -45,6 +45,8 @@ def summarize(events):
     health_series = {}
     flow_cache_series = {}
     nonfinite_events = []
+    recompile_events = []
+    oom_events = []
     meta = {}
     hangs = []
     t_min = t_max = None
@@ -74,6 +76,10 @@ def summarize(events):
         elif kind == "meta":
             if ev.get("name") == "nonfinite":
                 nonfinite_events.append(ev)
+            elif ev.get("name") == "xla_recompile":
+                recompile_events.append(ev)
+            elif ev.get("name") == "oom":
+                oom_events.append(ev)
             meta[ev.get("name", "?")] = ev
         elif kind == "hang":
             hangs.append(ev)
@@ -113,9 +119,40 @@ def summarize(events):
     if flow_cache_series.get("flow_cache/compute_ms"):
         series = flow_cache_series["flow_cache/compute_ms"]
         flow_cache["compute_ms_mean"] = sum(series) / len(series)
+    # XLA compile ledger + HBM watermarks (ISSUE 5): per-label compile
+    # counts from the counters, recompile tripwire events from meta,
+    # and the worst peak/limit fraction across devices (None on CPU,
+    # where no mem/* counters exist)
+    compiles = {}
+    for name, (value, _) in counters.items():
+        m = str(name)
+        if m.startswith("xla/compile/") and m.endswith("/count"):
+            compiles[m[len("xla/compile/"):-len("/count")]] = \
+                int(value or 0)
+    mem_peak_frac = None
+    for name, (value, _) in counters.items():
+        m = str(name)
+        if m.startswith("mem/") and m.endswith("/peak_bytes_in_use"):
+            dev = m[len("mem/"):-len("/peak_bytes_in_use")]
+            limit = counters.get(f"mem/{dev}/bytes_limit",
+                                 (None, None))[0]
+            if value and limit:
+                frac = float(value) / float(limit)
+                if mem_peak_frac is None or frac > mem_peak_frac:
+                    mem_peak_frac = frac
+    xla = {
+        "present": bool(compiles) or "xla/recompiles" in counters,
+        "compiles": compiles,
+        "recompiles": int(
+            counters.get("xla/recompiles", (0, None))[0] or 0)
+        or len([e for e in recompile_events]),
+        "recompile_events": recompile_events,
+        "mem_peak_frac": mem_peak_frac,
+        "oom_events": oom_events,
+    }
     return {"phases": table, "counters": counters, "meta": meta,
             "hangs": hangs, "wall_s": wall_s, "health": health,
-            "flow_cache": flow_cache}
+            "flow_cache": flow_cache, "xla": xla}
 
 
 def _trend(series):
@@ -170,6 +207,60 @@ def _health_section(s):
     return lines
 
 
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def _xla_section(s):
+    """Markdown lines for the compile-ledger/HBM section. Empty when
+    the run carried no xla/* counters (observability disabled)."""
+    x = s.get("xla") or {}
+    if not x.get("present"):
+        return []
+    lines = ["", "## xla compile ledger"]
+    for label in sorted(x.get("compiles", {})):
+        count = x["compiles"][label]
+        detail = ""
+        compile_meta = s["meta"].get(f"xla_compile/{label}")
+        if compile_meta:
+            mem = compile_meta.get("memory") or {}
+            parts = [f"compile {compile_meta.get('compile_ms', 0):.0f}ms"]
+            if mem.get("total_bytes"):
+                parts.append(f"footprint {_fmt_bytes(mem['total_bytes'])}"
+                             f" (temp {_fmt_bytes(mem.get('temp_bytes', 0))})")
+            if compile_meta.get("flops"):
+                parts.append(f"{compile_meta['flops']:.3g} flops")
+            detail = " — " + ", ".join(parts)
+        lines.append(f"- {label}: {count} compile(s){detail}")
+    n_re = x.get("recompiles", 0)
+    if n_re:
+        lines.append(f"!! {n_re} post-warmup recompile(s):")
+        for ev in x.get("recompile_events", []):
+            diff = ev.get("diff") or {}
+            changed = sorted((diff.get("changed") or {})) \
+                + sorted((diff.get("added") or {})) \
+                + sorted((diff.get("removed") or {}))
+            lines.append(f"  - {ev.get('label')}: changed leaves "
+                         f"{changed[:4]}")
+    else:
+        lines.append("- post-warmup recompiles: 0")
+    if x.get("mem_peak_frac") is not None:
+        lines.append(f"- peak HBM watermark: "
+                     f"{x['mem_peak_frac'] * 100:.1f}% of bytes_limit")
+    budget = s["meta"].get("mem_budget")
+    if budget and budget.get("budget_frac") is not None:
+        lines.append(f"- static budget (worst executable + state): "
+                     f"{budget['budget_frac'] * 100:.1f}% of limit")
+    for ev in x.get("oom_events", []):
+        lines.append(f"!! OOM in {ev.get('context')}: forensics at "
+                     f"{ev.get('report')}")
+    return lines
+
+
 def render_report(path_or_events):
     """Markdown-ish report (the PROFILE.md table format) for a
     telemetry.jsonl path or a pre-loaded event list."""
@@ -213,6 +304,7 @@ def render_report(path_or_events):
                      f"{flops_meta.get('peak_flops'):.4g} FLOP/s via "
                      f"{flops_meta.get('peak_source')})")
     lines.extend(_health_section(s))
+    lines.extend(_xla_section(s))
     if s["hangs"]:
         lines.append("")
         lines.append(f"!! {len(s['hangs'])} hang dump(s) recorded:")

@@ -48,11 +48,23 @@ class HangWatchdog(threading.Thread):
             if stall >= self.timeout_s:
                 if not fired:
                     fired = True
+                    # name the culprit when the stall IS a compile: the
+                    # ledger (xla_obs) keeps the currently-open
+                    # "compiling <label>" record
+                    try:
+                        from imaginaire_tpu.telemetry import xla_obs
+
+                        compiling = xla_obs.active_compile_label()
+                    except Exception:  # noqa: BLE001
+                        compiling = None
                     self._tm.dump_stacks(
                         f"no step completed in {stall:.1f}s "
-                        f"(hang_timeout_s={self.timeout_s:g}); either the "
-                        "input pipeline, a checkpoint commit, or a "
-                        "compile is stuck — see per-thread stacks")
+                        f"(hang_timeout_s={self.timeout_s:g}); "
+                        f"active compile: "
+                        f"{('compiling ' + compiling) if compiling else 'none'}; "
+                        "either the input pipeline, a checkpoint "
+                        "commit, or a compile is stuck — see "
+                        "per-thread stacks")
             else:
                 fired = False
 

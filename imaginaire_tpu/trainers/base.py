@@ -113,10 +113,16 @@ class BaseTrainer:
         # donated buffers (see train.py)
         self._donate = ((0,) if cfg_get(tcfg, "donate_step_buffers", True)
                         else ())
-        self._jit_gen_step = jax.jit(self._gen_step_fn,
-                                     donate_argnums=self._donate)
-        self._jit_dis_step = jax.jit(self._dis_step_fn,
-                                     donate_argnums=self._donate)
+        # step programs dispatch through the compile ledger
+        # (telemetry/xla_obs.py): the same compile that runs the step
+        # records memory_analysis/cost_analysis and arms the recompile
+        # tripwire; a disabled cfg.xla_obs degrades to plain jax.jit
+        from imaginaire_tpu.telemetry import xla_obs
+
+        self._jit_gen_step = xla_obs.compiled_program(
+            "gen_step", self._gen_step_fn, donate_argnums=self._donate)
+        self._jit_dis_step = xla_obs.compiled_program(
+            "dis_step", self._dis_step_fn, donate_argnums=self._donate)
 
     # ------------------------------------------------------------------ setup
 
@@ -536,39 +542,49 @@ class BaseTrainer:
             return 0
 
     def _register_step_flops(self, data):
-        """Register per-iteration FLOPs with telemetry ONCE, at jit
-        time, via XLA cost analysis of the two step programs — the
-        ``scripts/perf_lab.py`` method (``lowered.compile()
-        .cost_analysis()['flops']``), weighted by the dis_step/gen_step
-        multipliers. Guarded by ``telemetry.mfu``; failures degrade to a
-        debug log (MFU simply stays absent). Trainers whose update is
-        not the base two-program step (vid2vid's per-frame rollout)
-        override this to a no-op."""
+        """Register per-iteration FLOPs with telemetry ONCE, from the
+        compile ledger's cost analysis of the two step programs (the
+        ``scripts/perf_lab.py`` numbers, but recorded by the SAME
+        compile that runs the step — no duplicate lower/compile),
+        weighted by the dis_step/gen_step multipliers. Also emits the
+        one-shot static memory-budget report (executable footprints +
+        state tree sizes). Falls back to an explicit lower/compile when
+        the ledger is disabled. Guarded by ``telemetry.mfu``; failures
+        degrade to a debug log (MFU simply stays absent). Trainers
+        whose update is not the base two-program step (vid2vid's
+        per-frame rollout) override this to a no-op."""
         tm = telemetry.get()
         if self._step_flops_probed or not (tm.enabled and tm.wants_mfu) \
                 or tm.step_flops is not None:
             return
         self._step_flops_probed = True
-        from imaginaire_tpu.utils.misc import numeric_only
+        from imaginaire_tpu.telemetry import xla_obs
 
-        batch = numeric_only(data)
-        programs = [(self._jit_gen_step,
+        programs = [("gen_step", self._jit_gen_step,
                      cfg_get(self.cfg.trainer, "gen_step", 1))]
         if self.net_D is not None:
-            programs.append((self._jit_dis_step,
+            programs.append(("dis_step", self._jit_dis_step,
                              cfg_get(self.cfg.trainer, "dis_step", 1)))
+        ledger_flops = xla_obs.ledger_flops()
         total = 0.0
         try:
-            with telemetry.span("cost_analysis"):
-                for fn, mult in programs:
-                    cost = fn.lower(self.state, batch).compile() \
-                        .cost_analysis()
+            for label, fn, mult in programs:
+                flops = ledger_flops.get(label)
+                if flops is None:
+                    # ledger disabled/passthrough: the one-time
+                    # explicit compile the ledger otherwise replaces
+                    from imaginaire_tpu.utils.misc import numeric_only
+
+                    with telemetry.span("cost_analysis"):
+                        cost = fn.lower(self.state,
+                                        numeric_only(data)).compile() \
+                            .cost_analysis()
                     if isinstance(cost, list):
                         cost = cost[0]
-                    flops = cost.get("flops")
-                    if flops is None or not math.isfinite(float(flops)):
-                        return
-                    total += float(flops) * mult
+                    flops = (cost or {}).get("flops")
+                if flops is None or not math.isfinite(float(flops)):
+                    return
+                total += float(flops) * mult
         except Exception as e:  # noqa: BLE001 — MFU is best-effort
             import logging
 
@@ -576,6 +592,9 @@ class BaseTrainer:
                 "step cost analysis unavailable: %s", e)
             return
         tm.set_step_flops(total)
+        # both step executables exist by now: report whether the run
+        # fits (per-executable memory_analysis + param/opt/EMA bytes)
+        xla_obs.emit_budget_report(self.state, tm=tm)
 
     def _write_weight_stats(self, step):
         """Spectral-norm σ/weight-norm stats per logging interval

@@ -149,11 +149,12 @@ class Trainer(Vid2VidTrainer):
         self.state["opt_G"] = self.tx_G.init(params_G)
         self.state["opt_D"] = self.tx_D.init(
             self.state["vars_D"]["params"])
-        # the step programs closed over the old optimizer: re-trace
-        self._jit_vid_dis = jax.jit(self._vid_dis_step_fn,
-                                    donate_argnums=self._donate)
-        self._jit_vid_gen = jax.jit(self._vid_gen_step_fn,
-                                    donate_argnums=self._donate)
+        # the step programs closed over the old optimizer: drop the
+        # cached executables and re-trace. This is the one legitimate
+        # re-jit in the codebase — the ledger records it as expected
+        # (allowlisted) so the recompile tripwire stays silent.
+        self._jit_vid_dis.retrace("fs_vid2vid finetune re-jit")
+        self._jit_vid_gen.retrace("fs_vid2vid finetune re-jit")
 
         ref_labels = data["ref_labels"]
         ref_images = data["ref_images"]

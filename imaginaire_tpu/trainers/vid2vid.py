@@ -55,10 +55,19 @@ class Trainer(BaseTrainer):
             if ds is not None and hasattr(ds, "sequence_length_max"):
                 self.sequence_length_max = min(self.sequence_length_max,
                                                ds.sequence_length_max)
-        self._jit_vid_dis = jax.jit(self._vid_dis_step_fn,
-                                    donate_argnums=self._donate)
-        self._jit_vid_gen = jax.jit(self._vid_gen_step_fn,
-                                    donate_argnums=self._donate)
+        # per-frame programs ride the compile ledger like the base step
+        # programs; allow_shape_growth: the sequence-length curriculum
+        # and ring-buffer warm-up legitimately re-specialize on new
+        # shapes (same dtypes/shardings), which must not trip the
+        # recompile tripwire
+        from imaginaire_tpu.telemetry import xla_obs
+
+        self._jit_vid_dis = xla_obs.compiled_program(
+            "vid_dis_step", self._vid_dis_step_fn,
+            donate_argnums=self._donate, allow_shape_growth=True)
+        self._jit_vid_gen = xla_obs.compiled_program(
+            "vid_gen_step", self._vid_gen_step_fn,
+            donate_argnums=self._donate, allow_shape_growth=True)
         # Whole-rollout mode (SURVEY §7 hard-part #3): once the history
         # ring buffers reach their steady-state shapes, the remaining
         # frames run as ONE lax.scan program — per-frame D+G updates with
@@ -67,8 +76,9 @@ class Trainer(BaseTrainer):
         # trainer.rollout_scan; see gen_update/_rollout_scan_tail.
         self.rollout_scan = bool(cfg_get(cfg.trainer, "rollout_scan",
                                          False))
-        self._jit_rollout_tail = jax.jit(self._rollout_tail_fn,
-                                         donate_argnums=self._donate)
+        self._jit_rollout_tail = xla_obs.compiled_program(
+            "rollout_tail", self._rollout_tail_fn,
+            donate_argnums=self._donate, allow_shape_growth=True)
 
     # ---------------------------------------------------------------- loss
 

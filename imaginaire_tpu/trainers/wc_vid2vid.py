@@ -98,12 +98,14 @@ class Trainer(Vid2VidTrainer):
         else:
             print("single_image_model: RANDOM weights "
                   "(allow_random_init) — test use only")
-        import jax as _jax
+        from imaginaire_tpu.telemetry import xla_obs
 
-        self._jit_single = _jax.jit(
+        self._jit_single = xla_obs.compiled_program(
+            "wc_single_image",
             lambda v, d, k: self.single_image_model.apply(
                 v, d, random_style=True, training=False,
-                rngs={"noise": k}))
+                rngs={"noise": k}),
+            allow_shape_growth=True)
 
     @staticmethod
     def _resolve_config_path(path, parent_config_path):

@@ -10,6 +10,7 @@ import argparse
 
 import jax
 
+from imaginaire_tpu import telemetry
 from imaginaire_tpu.config import Config, cfg_get
 from imaginaire_tpu.data import get_test_dataloader
 from imaginaire_tpu.parallel.mesh import (
@@ -43,6 +44,10 @@ def main():
     date_uid, logdir = init_logging(args.config, args.logdir)
     make_logging_dir(logdir)
     cfg.logdir = logdir
+    # inference runs produce the same telemetry jsonl as training:
+    # data_wait/eval spans from the test loop, ckpt_load spans, and the
+    # xla_obs compile ledger / memory counters (ISSUE 5 satellite)
+    telemetry.configure(cfg, logdir=logdir)
 
     test_loader = get_test_dataloader(cfg)
     trainer_cls = resolve(cfg.trainer.type, "Trainer")
@@ -60,6 +65,7 @@ def main():
     inference_args = cfg_get(cfg, "inference_args", None)
     trainer.test(test_loader, args.output_dir,
                  dict(inference_args) if inference_args else None)
+    telemetry.get().shutdown()
     print(f"Done with inference. Outputs in {args.output_dir}")
 
 

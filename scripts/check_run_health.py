@@ -34,10 +34,35 @@ from imaginaire_tpu.telemetry.report import (  # noqa: E402
 )
 
 
-def check_health(summary, require_health=False, max_dg_breaches=0):
+def check_health(summary, require_health=False, max_dg_breaches=0,
+                 max_recompiles=0, mem_budget_frac=None):
     """Return the list of failure strings for an aggregated summary."""
     failures = []
     health = summary.get("health") or {}
+    # XLA observability gates (ISSUE 5): post-warmup recompiles beyond
+    # the budget (default 0 — a warm step loop must not re-specialize)
+    # and, when --mem-budget-frac is given, a peak-HBM watermark past
+    # that fraction of bytes_limit. Runs without xla/mem counters
+    # (observability off, CPU) pass both unchanged.
+    xla = summary.get("xla") or {}
+    recompiles = xla.get("recompiles", 0)
+    if max_recompiles is not None and recompiles > max_recompiles:
+        labels = sorted({e.get("label") for e
+                         in xla.get("recompile_events", [])} - {None})
+        failures.append(
+            f"{recompiles} post-warmup XLA recompile(s) "
+            f"(allowed {max_recompiles})"
+            + (f": labels {labels}" if labels else ""))
+    peak_frac = xla.get("mem_peak_frac")
+    if mem_budget_frac is not None and peak_frac is not None \
+            and peak_frac > mem_budget_frac:
+        failures.append(
+            f"peak HBM watermark {peak_frac:.1%} of bytes_limit "
+            f"exceeds --mem-budget-frac {mem_budget_frac:g}")
+    if xla.get("oom_events"):
+        failures.append(
+            f"{len(xla['oom_events'])} RESOURCE_EXHAUSTED event(s) — "
+            f"see oom_report.json")
     n_bad = health.get("nonfinite_event_count", 0)
     if n_bad:
         events = health.get("nonfinite_events") or []
@@ -71,6 +96,13 @@ def main(argv=None):
     ap.add_argument("--max-dg-breaches", type=int, default=0,
                     help="tolerated health/dg_ratio_breach emissions "
                          "(default 0)")
+    ap.add_argument("--max-recompiles", type=int, default=0,
+                    help="tolerated post-warmup XLA recompiles "
+                         "(xla/recompiles counter; default 0)")
+    ap.add_argument("--mem-budget-frac", type=float, default=None,
+                    help="fail when the peak HBM watermark exceeds "
+                         "this fraction of bytes_limit (default: no "
+                         "memory gate)")
     ap.add_argument("--json", action="store_true",
                     help="print the verdict as JSON")
     args = ap.parse_args(argv)
@@ -83,13 +115,19 @@ def main(argv=None):
         return 2
     summary = summarize(load_events(path))
     failures = check_health(summary, require_health=args.require_health,
-                            max_dg_breaches=args.max_dg_breaches)
+                            max_dg_breaches=args.max_dg_breaches,
+                            max_recompiles=args.max_recompiles,
+                            mem_budget_frac=args.mem_budget_frac)
     health = summary.get("health") or {}
+    xla = summary.get("xla") or {}
     if args.json:
         print(json.dumps({
             "path": path,
             "healthy": not failures,
             "failures": failures,
+            "recompiles": xla.get("recompiles", 0),
+            "compiles": xla.get("compiles", {}),
+            "mem_peak_frac": xla.get("mem_peak_frac"),
             "nonfinite_events": health.get("nonfinite_event_count", 0),
             "nonfinite_skipped": health.get("nonfinite_skipped", 0),
             "dg_ratio_ewma": health.get("dg_ratio_ewma"),

@@ -91,6 +91,12 @@ def main():
     from imaginaire_tpu.utils.visualization.common import tensor2im
 
     cfg = Config(args.config)
+    # same telemetry jsonl as training (ISSUE 5 satellite): spans +
+    # compile-ledger counters land beside the output image
+    from imaginaire_tpu import telemetry
+
+    telemetry.configure(cfg, logdir=os.path.dirname(
+        os.path.abspath(args.output)))
     label = load_label(cfg, args.label)[None]  # (1, H, W, C)
     data = {"label": label,
             "images": np.zeros(label.shape[:3] + (3,), np.float32)}
@@ -122,6 +128,7 @@ def main():
     img = tensor2im(np.asarray(jax.device_get(fake)))[0]
     os.makedirs(os.path.dirname(os.path.abspath(args.output)), exist_ok=True)
     save_pilimage_in_jpeg(args.output, Image.fromarray(img))
+    telemetry.get().shutdown()
     print(f"Wrote {args.output}")
 
 

@@ -229,11 +229,15 @@ def test_meter_fanout_keeps_tensorboard_parity(tm_sandbox, tmp_path,
     telemetry.get().shutdown()
 
     # TB got the averaged scalar exactly once (via the sink, not the
-    # direct writer path on top of it)
-    assert stub.scalars == [("data/host_wait_ms", 3.0, 11)]
+    # direct writer path on top of it). The xla_obs ledger may add its
+    # own xla/* / mem/* counters on the flush cadence — those are not
+    # meter fanout and are filtered from the parity check.
+    meter_scalars = [s for s in stub.scalars
+                     if not s[0].startswith(("xla/", "mem/"))]
+    assert meter_scalars == [("data/host_wait_ms", 3.0, 11)]
     events = _read_jsonl(str(tmp_path / "telemetry.jsonl"))
-    counter = next(e for e in events if e["kind"] == "counter")
-    assert counter["name"] == "data/host_wait_ms"
+    counter = next(e for e in events if e["kind"] == "counter"
+                   and e["name"] == "data/host_wait_ms")
     assert counter["value"] == 3.0 and counter["step"] == 11
 
 
@@ -417,10 +421,13 @@ def test_trainer_step_emits_spans_counters_and_mfu(tm_sandbox, tmp_path):
 
     events = _read_jsonl(str(tmp_path / "telemetry.jsonl"))
     names = {e["name"] for e in events if e["kind"] == "span"}
-    assert {"data_wait", "dis_step", "gen_step", "cost_analysis"} <= names
+    # no cost_analysis span anymore: the compile ledger (xla_obs)
+    # records FLOPs from the same compile that runs the step
+    assert {"data_wait", "dis_step", "gen_step"} <= names
     counters = {e["name"] for e in events if e["kind"] == "counter"}
     assert "perf/imgs_per_sec" in counters
     assert "perf/mfu" in counters  # XLA cost analysis worked on CPU
+    assert any(c.startswith("xla/compile/gen_step/") for c in counters)
     spans = [e for e in events if e["kind"] == "span"
              and e["name"] == "gen_step"]
     assert len(spans) == 3

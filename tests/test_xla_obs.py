@@ -1,0 +1,407 @@
+"""XLA observability coverage (ISSUE 5): compile-ledger schema +
+jsonl round-trip, recompile-tripwire semantics (fires on dtype/shape
+drift naming the changed leaf, silent on warm re-calls / shape-growth
+labels / allowlisted re-jits, raises under strict), CPU graceful
+degradation of the HBM paths, OOM forensics from a faked
+RESOURCE_EXHAUSTED, and the report + check_run_health gate legs for
+``xla/recompiles`` and the memory-budget watermark."""
+
+import json
+import logging
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from imaginaire_tpu import telemetry
+from imaginaire_tpu.telemetry import core as tcore
+from imaginaire_tpu.telemetry import xla_obs
+from imaginaire_tpu.telemetry.report import render_report, summarize
+
+HERE = os.path.dirname(__file__)
+ROOT = os.path.abspath(os.path.join(HERE, ".."))
+sys.path.insert(0, ROOT)
+
+from scripts.check_run_health import check_health  # noqa: E402
+
+
+@pytest.fixture
+def obs_sandbox():
+    """Isolate BOTH process singletons: a fresh ledger + settings and
+    a restorable telemetry instance per test."""
+    old_tm = tcore._TELEMETRY
+    xla_obs._reset_for_tests()
+    yield
+    tcore._TELEMETRY.shutdown()
+    tcore._TELEMETRY = old_tm
+    xla_obs._reset_for_tests()
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ------------------------------------------------------------- the ledger
+
+
+def test_ledger_records_compile_with_memory_and_flops(obs_sandbox):
+    prog = xla_obs.compiled_program("toy", lambda x: x @ x.T)
+    out = prog(jnp.ones((4, 8)))
+    assert out.shape == (4, 4)
+    led = xla_obs.ledger()
+    assert len(led.records) == 1
+    entry = led.records[0]
+    assert entry["label"] == "toy"
+    assert entry["lower_ms"] >= 0 and entry["compile_ms"] > 0
+    assert entry["recompile"] is False
+    # memory_analysis is real on CPU for arguments/outputs
+    assert entry["memory"]["argument_bytes"] > 0
+    assert entry["memory"]["output_bytes"] > 0
+    assert entry["flops"] and entry["flops"] > 0
+    assert led.label_flops["toy"] == entry["flops"]
+
+
+def test_warm_recall_is_a_cache_hit_not_a_compile(obs_sandbox):
+    prog = xla_obs.compiled_program("toy", lambda x: x * 2)
+    x = jnp.ones((3, 3))
+    a, b, c = prog(x), prog(x), prog(x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(c))
+    led = xla_obs.ledger()
+    assert len(led.records) == 1
+    assert led.cache_hits["toy"] == 2
+    assert led.recompiles == 0
+    assert prog._cache_size() == 1
+
+
+def test_ledger_jsonl_roundtrip(obs_sandbox, tmp_path):
+    """Every compile lands in compile_ledger.jsonl with the schema the
+    forensics tooling parses — including compiles that predate
+    telemetry.configure (replayed when the logdir arrives)."""
+    prog = xla_obs.compiled_program("pre", lambda x: x + 1)
+    prog(jnp.ones((2,)))  # before configure: buffered in memory
+    tm = telemetry.configure(logdir=str(tmp_path), enabled=True,
+                             sinks=["jsonl"], flush_every_n_steps=0)
+    post = xla_obs.compiled_program("post", lambda x: x - 1)
+    post(jnp.ones((2,)))
+    tm.shutdown()
+
+    entries = _read_jsonl(str(tmp_path / "compile_ledger.jsonl"))
+    by_label = {e["label"]: e for e in entries}
+    assert set(by_label) == {"pre", "post"}
+    for entry in entries:
+        assert entry["kind"] == "compile"
+        assert {"label", "t", "fingerprint", "lower_ms", "compile_ms",
+                "recompile", "expected", "counted_recompile", "memory",
+                "flops"} <= set(entry)
+        assert len(entry["fingerprint"]) == 12
+    # the replayed pre-configure compile also reached the telemetry
+    # jsonl as xla/compile/* counters
+    events = _read_jsonl(str(tmp_path / "telemetry.jsonl"))
+    counters = {e["name"] for e in events if e["kind"] == "counter"}
+    assert "xla/compile/pre/count" in counters
+    assert "xla/compile/pre/argument_bytes" in counters
+    assert "xla/compile/post/count" in counters
+
+
+# ----------------------------------------------------------- the tripwire
+
+
+def test_tripwire_names_changed_leaf_on_dtype_change(obs_sandbox,
+                                                     caplog):
+    prog = xla_obs.compiled_program("step", lambda d: d["x"] * 2)
+    prog({"x": jnp.ones((4, 4), jnp.float32)})
+    with caplog.at_level(logging.WARNING,
+                         logger="imaginaire_tpu.telemetry.xla_obs"):
+        prog({"x": jnp.ones((4, 4), jnp.bfloat16)})
+    led = xla_obs.ledger()
+    assert led.recompiles == 1
+    entry = led.records[-1]
+    assert entry["counted_recompile"] is True
+    (path, (old, new)), = entry["diff"]["changed"].items()
+    assert "'x'" in path or "x" in path
+    assert "float32" in old and "bfloat16" in new
+    assert entry["diff"]["shape_only"] is False
+    # the warning names the leaf too
+    assert any("RECOMPILE of step" in r.message and "bfloat16" in r.message
+               for r in caplog.records)
+
+
+def test_tripwire_counts_shape_change_unless_label_allows_growth(
+        obs_sandbox):
+    strict_prog = xla_obs.compiled_program("fixed", lambda x: x * 2)
+    strict_prog(jnp.ones((4, 4)))
+    strict_prog(jnp.ones((8, 4)))
+    assert xla_obs.ledger().recompiles == 1
+    assert xla_obs.ledger().records[-1]["diff"]["shape_only"] is True
+
+    poly = xla_obs.compiled_program("poly", lambda x: x * 2,
+                                    allow_shape_growth=True)
+    poly(jnp.ones((4, 4)))
+    poly(jnp.ones((8, 4)))
+    led = xla_obs.ledger()
+    assert led.recompiles == 1  # unchanged: poly's growth is expected
+    assert led.records[-1]["expected"] == "shape_growth"
+    # but a dtype flip on a shape-poly label still counts
+    poly(jnp.ones((8, 4), jnp.bfloat16))
+    assert led.recompiles == 2
+
+
+def test_sharding_settle_after_first_step_is_expected(obs_sandbox):
+    """The train.py warmup transition: uncommitted init state comes
+    back from step 1 as committed NamedSharding arrays — the resulting
+    re-specialization is expected (plain jit recompiles there too),
+    but the REVERSE transition still counts."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    prog = xla_obs.compiled_program("gen_step", lambda s: s["p"] * 2)
+    uncommitted = {"p": jnp.ones((4, 4))}
+    committed = jax.device_put(uncommitted, NamedSharding(mesh, P()))
+    prog(uncommitted)
+    prog(committed)
+    led = xla_obs.ledger()
+    assert led.recompiles == 0
+    assert led.records[-1]["expected"] == "sharding_commit"
+    # flip-flopping BACK to a seen fingerprint is a warm hit, not a
+    # compile at all
+    prog(uncommitted)
+    assert led.cache_hits["gen_step"] == 1 and led.recompiles == 0
+    # but a committed-spec CHANGE is real input drift and counts
+    prog(jax.device_put(uncommitted, NamedSharding(mesh, P("data"))))
+    assert led.recompiles == 1
+    assert led.records[-1]["diff"]["sharding_settle_only"] is False
+
+
+def test_strict_recompile_raises(obs_sandbox):
+    xla_obs.settings().strict_recompile = True
+    prog = xla_obs.compiled_program("step", lambda x: x * 2)
+    prog(jnp.ones((2, 2)))
+    with pytest.raises(xla_obs.RecompileError, match="step"):
+        prog(jnp.ones((3, 3)))
+
+
+def test_retrace_is_an_expected_rejit(obs_sandbox):
+    """The fs_vid2vid finetune pattern: the closure changed, retrace()
+    drops cached executables, and the next compile is ledgered as
+    expected — no tripwire, no counter."""
+    scale = [2.0]
+    prog = xla_obs.compiled_program("vid_gen_step",
+                                    lambda x: x * scale[0])
+    x = jnp.ones((2, 2))
+    np.testing.assert_allclose(np.asarray(prog(x)), 2.0)
+    scale[0] = 5.0
+    prog.retrace("fs_vid2vid finetune re-jit")
+    # the re-jit actually retraces (sees the new closure)...
+    np.testing.assert_allclose(np.asarray(prog(x)), 5.0)
+    led = xla_obs.ledger()
+    assert led.recompiles == 0
+    assert led.records[-1]["expected"] == "fs_vid2vid finetune re-jit"
+    assert led.records[-1]["recompile"] is True
+
+
+def test_expected_recompiles_allowlist(obs_sandbox):
+    xla_obs.settings().expected_recompiles = ("blessed",)
+    prog = xla_obs.compiled_program("blessed", lambda x: x * 2)
+    prog(jnp.ones((2, 2)))
+    prog(jnp.ones((4, 4), jnp.bfloat16))  # would otherwise count
+    led = xla_obs.ledger()
+    assert led.recompiles == 0
+    assert led.records[-1]["expected"] == "xla_obs.expected_recompiles"
+
+
+def test_donated_step_program_dispatches_through_ledger(obs_sandbox):
+    """The trainer-shaped call: dict state donated, dict batch — the
+    AOT table serves the executable and donation still invalidates."""
+    def step(state, data):
+        return {"p": state["p"] - 0.1 * jnp.mean(data["x"])}
+
+    prog = xla_obs.compiled_program("gen_step", step, donate_argnums=(0,))
+    state = {"p": jnp.ones((4,))}
+    data = {"x": jnp.ones((2, 2))}
+    for _ in range(3):
+        state = prog(state, data)
+    assert prog._cache_size() == 1
+    assert xla_obs.ledger().cache_hits["gen_step"] == 2
+    np.testing.assert_allclose(np.asarray(state["p"]), 0.7, rtol=1e-6)
+
+
+# ------------------------------------------------- CPU graceful degradation
+
+
+def test_memory_paths_degrade_on_cpu(obs_sandbox):
+    """CPU memory_stats() is None: the watermark sampler is a no-op,
+    peak HBM is None, and the budget report still sizes the state."""
+    assert jax.devices()[0].memory_stats() is None  # test premise
+    assert xla_obs.device_memory_stats() == {}
+    assert xla_obs.peak_hbm_bytes() is None
+    sink_events = []
+
+    class _Cap:
+        def counter(self, name, value, step=None):
+            sink_events.append(name)
+
+    assert xla_obs.sample_memory(tm=_Cap()) == {}
+    assert sink_events == []  # no mem/* counters fabricated
+    state = {"vars_G": {"params": {"w": jnp.ones((8, 8))}},
+             "opt_G": {"m": jnp.ones((8, 8))}}
+    report = xla_obs.static_budget_report(state)
+    assert report["state_bytes"]["vars_G"] == 8 * 8 * 4
+    assert report["state_bytes"]["_total"] == 2 * 8 * 8 * 4
+    assert "budget_frac" not in report  # no bytes_limit on CPU
+    census = xla_obs.live_array_census()
+    assert isinstance(census, list)
+    for row in census:
+        assert {"dtype", "shape", "count", "total_bytes"} <= set(row)
+
+
+# --------------------------------------------------------------- forensics
+
+
+def test_oom_forensics_writes_report_and_reraises(obs_sandbox, tmp_path):
+    telemetry.configure(logdir=str(tmp_path), enabled=True,
+                        sinks=["jsonl"], flush_every_n_steps=0)
+    prog = xla_obs.compiled_program("gen_step", lambda x: x * 2)
+    prog(jnp.ones((2, 2)))  # give the report an executable footprint
+    err = RuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying "
+                       "to allocate 123456 bytes.")
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        with xla_obs.oom_forensics(context="program:gen_step"):
+            raise err
+    report = json.load(open(str(tmp_path / "oom_report.json")))
+    assert report["context"] == "program:gen_step"
+    assert report["requested_bytes"] == 123456
+    assert "gen_step" in report["executables"]
+    assert isinstance(report["live_array_census"], list)
+    assert isinstance(report["watermark_history"], list)
+    # non-OOM exceptions pass through without a report
+    os.remove(str(tmp_path / "oom_report.json"))
+    with pytest.raises(ValueError):
+        with xla_obs.oom_forensics(context="x"):
+            raise ValueError("shape mismatch")
+    assert not os.path.exists(str(tmp_path / "oom_report.json"))
+
+
+def test_parse_requested_bytes_units():
+    assert xla_obs.parse_requested_bytes(
+        "Attempting to allocate 1.50GiB in HBM") == int(1.5 * 2**30)
+    assert xla_obs.parse_requested_bytes(
+        "while allocating 4096 bytes") == 4096
+    assert xla_obs.parse_requested_bytes("no numbers here") is None
+
+
+# ------------------------------------------------- report + health gate
+
+
+def _jsonl_events(*events):
+    return list(events)
+
+
+def test_report_and_gate_fail_on_recompiles(obs_sandbox):
+    events = _jsonl_events(
+        {"kind": "counter", "name": "xla/compile/gen_step/count",
+         "value": 2, "step": 5, "t": 1.0},
+        {"kind": "counter", "name": "xla/recompiles", "value": 1,
+         "step": 5, "t": 1.0},
+        {"kind": "meta", "name": "xla_recompile", "label": "gen_step",
+         "t": 1.0,
+         "diff": {"changed": {"[0]['x']": ["f32[4]", "bf16[4]"]},
+                  "added": {}, "removed": {}, "shape_only": False}},
+    )
+    s = summarize(events)
+    assert s["xla"]["recompiles"] == 1
+    assert s["xla"]["compiles"]["gen_step"] == 2
+    failures = check_health(s, max_recompiles=0)
+    assert any("recompile" in f for f in failures)
+    assert not check_health(s, max_recompiles=1)
+    text = render_report(events)
+    assert "post-warmup recompile" in text
+    assert "gen_step" in text
+
+
+def test_gate_passes_clean_run_and_mem_budget_breach_fails(obs_sandbox):
+    clean = summarize(_jsonl_events(
+        {"kind": "counter", "name": "xla/compile/gen_step/count",
+         "value": 1, "step": 1, "t": 1.0},
+        {"kind": "counter", "name": "xla/recompiles", "value": 0,
+         "step": 1, "t": 1.0},
+    ))
+    assert check_health(clean, max_recompiles=0) == []
+    hot = summarize(_jsonl_events(
+        {"kind": "counter", "name": "mem/tpu0/peak_bytes_in_use",
+         "value": 15e9, "step": 1, "t": 1.0},
+        {"kind": "counter", "name": "mem/tpu0/bytes_limit",
+         "value": 16e9, "step": 1, "t": 1.0},
+    ))
+    assert hot["xla"]["mem_peak_frac"] == pytest.approx(15 / 16)
+    assert check_health(hot, mem_budget_frac=0.9)
+    assert not check_health(hot, mem_budget_frac=0.95)
+    # runs with no xla/mem counters at all pass both gates unchanged
+    legacy = summarize(_jsonl_events(
+        {"kind": "counter", "name": "perf/mfu", "value": 0.4,
+         "step": 1, "t": 1.0}))
+    assert check_health(legacy, max_recompiles=0,
+                        mem_budget_frac=0.9) == []
+
+
+def test_check_run_health_cli_max_recompiles(obs_sandbox, tmp_path):
+    """CLI legs: --max-recompiles 0 passes a clean jsonl and fails an
+    injected-recompile jsonl (the dryrun acceptance pair)."""
+    import subprocess
+
+    clean = tmp_path / "clean.jsonl"
+    clean.write_text(json.dumps(
+        {"kind": "counter", "name": "xla/recompiles", "value": 0,
+         "step": 1, "t": 1.0}) + "\n")
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(
+        {"kind": "counter", "name": "xla/recompiles", "value": 3,
+         "step": 1, "t": 1.0}) + "\n")
+    script = os.path.join(ROOT, "scripts", "check_run_health.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run([sys.executable, script, str(clean),
+                         "--max-recompiles", "0"],
+                        capture_output=True, text=True, env=env)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    fail = subprocess.run([sys.executable, script, str(bad),
+                           "--max-recompiles", "0"],
+                          capture_output=True, text=True, env=env)
+    assert fail.returncode == 1
+    assert "recompile" in fail.stdout
+
+
+# --------------------------------------------- watchdog names the compile
+
+
+def test_watchdog_dump_names_active_compile(obs_sandbox):
+    led = xla_obs.ledger()
+    assert xla_obs.active_compile_label() is None
+    led.begin("vid_gen_step")
+    try:
+        assert xla_obs.active_compile_label() == "vid_gen_step"
+    finally:
+        led.end("vid_gen_step")
+    assert xla_obs.active_compile_label() is None
+
+
+def test_hang_dump_header_includes_compile_label(obs_sandbox, capsys):
+    tm = telemetry.configure(enabled=True, sinks=[],
+                             flush_every_n_steps=0, hang_timeout_s=0.05)
+    led = xla_obs.ledger()
+    led.begin("flow_teacher")
+    try:
+        import time
+
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if "compiling flow_teacher" in capsys.readouterr().err:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("watchdog dump never named the open compile")
+    finally:
+        led.end("flow_teacher")
+        tm.shutdown()
