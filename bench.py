@@ -63,6 +63,29 @@ def _leg_summary(tm, xla_mark=None):
     return out
 
 
+def _parallel_leg(trainer=None):
+    """{mesh_shape, state_bytes_per_chip, update_state_bytes} for a
+    bench leg (ISSUE 6): which mesh the leg ran on and what the train
+    state actually costs PER CHIP under the active partition plan —
+    equal to the global tree size when state is replicated, 1/shard of
+    opt/EMA under cfg.parallel's cross-replica update-state sharding."""
+    from imaginaire_tpu.parallel.mesh import peek_mesh
+    from imaginaire_tpu.parallel.partition import (
+        per_device_tree_bytes,
+        state_bytes_report,
+    )
+
+    mesh = peek_mesh()
+    out = {"mesh_shape": {str(k): int(v)
+                          for k, v in dict(mesh.shape).items()}
+           if mesh is not None else None}
+    state = getattr(trainer, "state", None) if trainer is not None else None
+    if state:
+        out["state_bytes_per_chip"] = per_device_tree_bytes(state)
+        out["update_state_bytes"] = state_bytes_report(state)
+    return out
+
+
 def _xla_mark():
     """Ledger snapshot at a bench leg's start (before its compiles)."""
     from imaginaire_tpu.telemetry import xla_obs
@@ -866,10 +889,13 @@ def _pipeline_ab(cfg, iters=10):
     synth_rate = bs * iters / (time.time() - t0)
     synth_tm = _leg_summary(tm)
 
+    parallel_leg = _parallel_leg(trainer)
     trainer.state = None
     _, depth = prefetch_settings(cfg)
     return {
         "batch_size": bs,
+        # mesh + per-chip state residency (ISSUE 6)
+        "parallel": parallel_leg,
         "pipeline_sync_imgs_per_sec": round(sync_rate, 3),
         "pipeline_prefetch_imgs_per_sec": round(prefetch_rate, 3),
         "synthetic_imgs_per_sec": round(synth_rate, 3),
@@ -997,6 +1023,8 @@ def run(trainer, label_ch, batch_sizes, metric):
                 # per-leg compile cost + recompile tripwire + peak HBM
                 # (ISSUE 5); recompile_count must stay 0 post-warmup
                 "xla": _xla_leg(xla_mark),
+                # mesh + per-chip state residency (ISSUE 6)
+                "parallel": _parallel_leg(trainer),
             }))
             return
         except Exception as e:  # OOM etc. -> halve batch

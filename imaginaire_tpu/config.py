@@ -298,6 +298,30 @@ def default_config():
             dir=None,  # None -> <logdir>/flow_cache
             store_dtype="float16",  # on-disk flow dtype (conf is uint8)
         ),
+        # -- 2-D (data x model) parallelism (parallel/partition.py,
+        # ISSUE 6). mesh_shape opts in: {"data": N, "model": M} (or an
+        # [N, M] list aligned with axes) builds the 2-D mesh through
+        # mesh.mesh_from_config — the single mesh entry point — and
+        # activates the partition plan: wide generator/discriminator
+        # conv channel dims shard over 'model' per the logical-axis
+        # rules (DEFAULT_RULES; the rules mapping here overlays it,
+        # e.g. {conv_in: null} to keep in-channels replicated), while
+        # optimizer moments + the EMA tree additionally shard over the
+        # 'data' axis (cross-replica weight-update sharding, ZeRO-1 /
+        # arXiv:2004.13336) — each replica owns 1/N of the update
+        # state and params are re-gathered for the forward. Leaves
+        # narrower than min_shard_size (or indivisible by the axis)
+        # stay replicated. mesh_shape null keeps the legacy 1-D
+        # runtime.mesh data-parallel layout with fully replicated
+        # state, byte-identical to the seed's programs.
+        parallel=AttrDict(
+            mesh_shape=None,
+            axes=["data", "model"],
+            rules=AttrDict(),
+            min_shard_size=64,
+            shard_update_state=True,
+            enabled="auto",  # auto: active iff mesh_shape is set
+        ),
         # -- TPU runtime (replaces ref cudnn/local_rank blocks, config.py:143-150)
         runtime=AttrDict(
             mesh=AttrDict(axes=["data"], shape=None),  # shape None => all devices on 'data'

@@ -10,7 +10,9 @@ from imaginaire_tpu.optim.optimizers import (
     fromage,
     get_optimizer_for_params,
     get_scheduler,
+    init_optimizer_state,
     madam,
 )
 
-__all__ = ["fromage", "madam", "get_optimizer_for_params", "get_scheduler"]
+__all__ = ["fromage", "madam", "get_optimizer_for_params", "get_scheduler",
+           "init_optimizer_state"]

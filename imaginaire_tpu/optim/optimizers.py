@@ -125,6 +125,34 @@ def get_scheduler(cfg_opt, iters_per_epoch: int = 1) -> Callable[[int], float]:
     raise NotImplementedError(f"Learning rate policy {ptype} not implemented.")
 
 
+def init_optimizer_state(tx, params, plan=None):
+    """``tx.init(params)``, materialized under a partition plan.
+
+    With an active ``PartitionPlan`` (parallel/partition.py) the init
+    runs as a jitted program whose ``out_shardings`` are the plan's
+    cross-replica update-state specs (arXiv:2004.13336): every moment
+    leaf is *born* as its 1/N data-axis shard (+ model-axis channel
+    shard where the rules match), so the full replicated moment tree —
+    2x param bytes for adam, the single biggest state entry in
+    PROFILE.md's budget — never exists on any chip, not even
+    transiently at init. Scalar bookkeeping leaves (adam ``count``,
+    madam ``step``/``p_max``) resolve to replicated. Without a plan
+    this is exactly ``tx.init(params)``.
+    """
+    if plan is None or not getattr(plan, "active", False):
+        return tx.init(params)
+    import jax
+    from jax.sharding import NamedSharding
+
+    shapes = jax.eval_shape(tx.init, params)
+    specs = plan.update_state_specs(shapes)
+    mesh = plan.mesh
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda s: type(s).__name__ == "PartitionSpec")
+    return jax.jit(tx.init, out_shardings=shardings)(params)
+
+
 def get_optimizer_for_params(cfg_opt, sched: Optional[Callable[[int], float]] = None):
     """Build the optax chain for one network (ref: utils/trainer.py:261-306).
 

@@ -19,6 +19,7 @@ except ImportError:  # older jax: the experimental home
 from imaginaire_tpu.parallel.mesh import (
     create_mesh,
     get_mesh,
+    mesh_from_config,
     set_mesh,
     init_distributed,
     get_rank,
@@ -26,6 +27,12 @@ from imaginaire_tpu.parallel.mesh import (
     is_master,
     master_only,
     master_only_print,
+)
+from imaginaire_tpu.parallel.partition import (
+    DEFAULT_RULES,
+    PartitionPlan,
+    per_device_tree_bytes,
+    state_bytes_report,
 )
 from imaginaire_tpu.parallel.sharding import (
     batch_sharding,
@@ -39,6 +46,11 @@ __all__ = [
     "shard_map",
     "create_mesh",
     "get_mesh",
+    "mesh_from_config",
+    "DEFAULT_RULES",
+    "PartitionPlan",
+    "per_device_tree_bytes",
+    "state_bytes_report",
     "set_mesh",
     "init_distributed",
     "get_rank",

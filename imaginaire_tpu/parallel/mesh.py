@@ -7,12 +7,22 @@ the reference's one-process-per-GPU model; chips within a host are
 addressed through the mesh, not through processes.
 
 Mesh axes (all optional except ``data``):
-  data    : data parallelism — batch sharded, params replicated, grads psum'd.
-  model   : tensor parallelism headroom (unused by the 9 reference algorithms,
-            reserved so configs can request a 2-D mesh without code changes).
+  data    : data parallelism — batch sharded, grads psum'd; with
+            ``cfg.parallel.shard_update_state`` the optimizer/EMA trees
+            shard over this axis too (parallel/partition.py).
+  model   : tensor parallelism — wide generator/discriminator conv
+            channel dims shard here per the ``cfg.parallel.rules``
+            logical-axis table (parallel/partition.py). Requesting a
+            model axis that no rule consumes logs a loud warning
+            instead of silently replicating (the old reserved-but-dead
+            MODEL_AXIS trap).
   seq     : context/sequence parallelism for long video rollouts (frame axis
             sharding with ppermute ring exchange of carried frames) — the
             TPU-native extension filling SURVEY.md section 5.7.
+
+``mesh_from_config`` is the single config entry point: it prefers the
+``cfg.parallel`` group (``mesh_shape``/``axes``) and falls back to the
+legacy ``cfg.runtime.mesh`` block.
 """
 
 from __future__ import annotations
@@ -74,9 +84,55 @@ def create_mesh(axes=("data",), shape=None, devices=None):
         dims = [int(s) for s in shape]
     else:
         dims = [int(shape[a]) if (hasattr(shape, "__getitem__") and a in shape) else 1 for a in axes]
-    if int(np.prod(dims)) != devices.size:
+    want = int(np.prod(dims))
+    if want > devices.size:
         raise ValueError(f"mesh shape {dims} != device count {devices.size}")
+    if want < devices.size:
+        # an explicit sub-mesh request (e.g. a (2,2) plan on an 8-chip
+        # host): take the first prod(shape) devices instead of failing —
+        # the remaining devices simply stay out of this mesh
+        import logging
+
+        logging.getLogger(__name__).info(
+            "mesh shape %s uses %d of %d devices", dims, want,
+            devices.size)
+        devices = devices.reshape(-1)[:want]
     return Mesh(devices.reshape(dims), axes)
+
+
+def mesh_from_config(cfg, devices=None):
+    """Build the process mesh from a full experiment config.
+
+    The ``cfg.parallel`` group wins when its ``mesh_shape`` is set (the
+    2-D data x model entry point, see parallel/partition.py); otherwise
+    the legacy ``cfg.runtime.mesh`` {axes, shape} block applies, whose
+    default (axes=['data'], shape=None) is the seed's pure-DP layout.
+    """
+    from imaginaire_tpu.config import cfg_get
+
+    pcfg = cfg_get(cfg or {}, "parallel", None) or {}
+    shape = cfg_get(pcfg, "mesh_shape", None)
+    if shape is not None:
+        axes = tuple(cfg_get(pcfg, "axes", None) or (DATA_AXIS, MODEL_AXIS))
+        return create_mesh(axes, shape, devices=devices)
+    rcfg = cfg_get(cfg_get(cfg or {}, "runtime", None) or {}, "mesh",
+                   None) or {}
+    axes = tuple(cfg_get(rcfg, "axes", None) or (DATA_AXIS,))
+    mesh = create_mesh(axes, cfg_get(rcfg, "shape", None), devices=devices)
+    if dict(mesh.shape).get(MODEL_AXIS, 1) > 1:
+        # the old reserved-but-dead MODEL_AXIS trap: a legacy
+        # runtime.mesh model axis has no consumer unless cfg.parallel
+        # activates the partition plan — say so instead of silently
+        # replicating params across it
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "runtime.mesh requests a model axis of size %d but "
+            "cfg.parallel.mesh_shape is unset — no partition rules will "
+            "consume it (params replicate across the axis). Set "
+            "parallel.mesh_shape to activate the 2-D partition plan.",
+            dict(mesh.shape)[MODEL_AXIS])
+    return mesh
 
 
 def set_mesh(mesh):

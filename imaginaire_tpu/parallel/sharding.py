@@ -35,8 +35,16 @@ def batch_sharding(mesh=None, axis=DATA_AXIS):
 
 def _batch_spec_for(x, axis, axis_size=None):
     """Leading-dim spec over ``axis``; replicated (P()) for scalars and
-    for leaves whose dim 0 the axis size does not divide (a bs-2 batch on
-    an 8-device mesh must not fail the whole transfer)."""
+    for leaves whose dim 0 the NAMED AXIS size does not divide (a bs-2
+    batch on an 8-device 1-D mesh must not fail the whole transfer).
+
+    The divisibility check is against ``axis_size`` — the size of the
+    ``data`` axis alone — never ``mesh.size``: on a 2-D ``(data=2,
+    model=2)`` mesh a bs-2 batch shards fine over ``data`` (each data
+    row's model devices replicate their slice), and demanding
+    divisibility by all 4 chips would silently demote every 2-D-mesh
+    run to the uncommitted synchronous transfer path.
+    """
     if hasattr(x, "ndim") and x.ndim >= 1:
         if axis_size is not None and (
                 x.shape[0] == 0 or x.shape[0] % axis_size != 0):
@@ -46,10 +54,16 @@ def _batch_spec_for(x, axis, axis_size=None):
 
 
 def batch_pytree_shardings(batch, mesh=None, axis=DATA_AXIS):
-    """Per-leaf NamedShardings sharding dim 0 of every array leaf
-    (replicated where dim 0 is not divisible by the axis size)."""
+    """Per-leaf NamedShardings sharding dim 0 of every array leaf over
+    the named ``axis`` (replicated where dim 0 is not divisible by that
+    axis's size — NOT the whole mesh size; extra mesh axes like
+    ``model`` replicate batch leaves)."""
     mesh = mesh or get_mesh()
-    size = mesh.shape[axis]
+    size = dict(mesh.shape).get(axis)
+    if size is None:
+        # a mesh without the requested axis can't shard the batch at
+        # all — replicate every leaf rather than KeyError the transfer
+        return jax.tree.map(lambda x: NamedSharding(mesh, P()), batch)
     return jax.tree.map(
         lambda x: NamedSharding(mesh, _batch_spec_for(x, axis, size)), batch)
 

@@ -429,6 +429,24 @@ class CompiledProgram:
                                 donate_argnums=self._donate_argnums)
         _telemetry().meta("xla_retrace", label=self.label, reason=reason)
 
+    def aot_compile(self, *args):
+        """Compile (and ledger) the program for these args WITHOUT
+        executing it — the capacity-planning entry
+        (scripts/partition_budget.py): args may be ``ShapeDtypeStruct``
+        trees carrying ``NamedSharding``s, so a shape that does not fit
+        a chip can still be lowered/compiled and its
+        ``memory_analysis`` recorded. Returns the ledger's memory dict
+        for this label ({} when the compile failed/passthrough)."""
+        try:
+            digest, leaves = fingerprint(args)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("aot_compile fingerprint failed for %s: %s",
+                           self.label, e)
+            return {}
+        if digest not in self._executables:
+            self._compile(digest, leaves, args)
+        return dict(_LEDGER.label_memory.get(self.label, {}))
+
     def _debug_nans_on(self):
         try:
             import jax

@@ -40,8 +40,13 @@ import optax
 
 from imaginaire_tpu import telemetry
 from imaginaire_tpu.config import as_attrdict, cfg_get
-from imaginaire_tpu.optim import get_optimizer_for_params, get_scheduler
+from imaginaire_tpu.optim import (
+    get_optimizer_for_params,
+    get_scheduler,
+    init_optimizer_state,
+)
 from imaginaire_tpu.parallel.mesh import is_master, master_only_print as print  # noqa: A001
+from imaginaire_tpu.parallel.partition import PartitionPlan
 from imaginaire_tpu.registry import resolve
 from imaginaire_tpu.utils import checkpoint as ckpt_lib
 from imaginaire_tpu.utils.meters import Meter
@@ -108,6 +113,16 @@ class BaseTrainer:
         from imaginaire_tpu.diagnostics import HealthMonitor
 
         self.diag = HealthMonitor(cfg)
+        # 2-D (data x model) partition plan (parallel/partition.py):
+        # inactive (the seed's replicated-state semantics, byte-identical
+        # programs) unless cfg.parallel opted in via mesh_shape/enabled.
+        # When active, init_state commits the train state under the
+        # plan's NamedShardings — wide conv channels over 'model',
+        # optimizer/EMA trees over 'data' (arXiv:2004.13336) — and the
+        # step programs constrain their output state to the same
+        # layout, so warm steps keep one stable fingerprint.
+        self.partition = PartitionPlan(cfg)
+        self._state_shardings = None
         # --debug-nans repro runs disable donation: jax_debug_nans
         # re-runs the op eagerly, which would read already-invalidated
         # donated buffers (see train.py)
@@ -159,7 +174,8 @@ class BaseTrainer:
         vars_G = dict(vars_G)
         state: Dict[str, Any] = {
             "vars_G": vars_G,
-            "opt_G": self.tx_G.init(vars_G["params"]),
+            "opt_G": init_optimizer_state(self.tx_G, vars_G["params"],
+                                          self.partition),
             "step": jnp.zeros((), jnp.int32),
             "rng_G": k_rg,
             "rng_D": k_rd,
@@ -171,7 +187,9 @@ class BaseTrainer:
                 lambda rngs, d, f: self.net_D.init(rngs, d, f, training=True))(
                 {"params": k_d, "dropout": k_d}, data, fake_out))
             state["vars_D"] = vars_D
-            state["opt_D"] = self.tx_D.init(vars_D["params"])
+            state["opt_D"] = init_optimizer_state(self.tx_D,
+                                                  vars_D["params"],
+                                                  self.partition)
             # Separate D step counter: with cfg.trainer.dis_step > 1 each
             # sub-step must draw distinct randomness (the G step only
             # advances 'step' once per iteration).
@@ -181,8 +199,31 @@ class BaseTrainer:
                 vars_G["params"], vars_G.get("spectral"),
                 remove_sn=self.model_average_remove_sn)
             state["num_ema_updates"] = jnp.zeros((), jnp.int32)
-        self.state = state
+        self.state = self._place_state(state)
+        return self.state
+
+    def _place_state(self, state):
+        """Commit the state pytree under the partition plan's shardings
+        (no-op without an active plan): params model-sharded per the
+        rules, optimizer/EMA trees cross-replica sharded over 'data',
+        everything committed BEFORE the first step so the compiled
+        programs see their final layout from call one — no
+        ``sharding_commit`` re-specialization, ``xla/recompiles`` 0."""
+        if not self.partition.active:
+            return state
+        state, self._state_shardings = self.partition.place_state(state)
         return state
+
+    def _constrain_state(self, state):
+        """Pin a step program's output state to the placement layout
+        (traced; no-op without an active plan). Keeping outputs on the
+        exact input shardings is what makes the update-state sharding a
+        steady state: moments stay 1/N-resident across steps, donation
+        aliases input buffers, and the recompile tripwire stays
+        quiet."""
+        if not self.partition.active or self._state_shardings is None:
+            return state
+        return self.partition.constrain_state(state, self._state_shardings)
 
     # ------------------------------------------------------- subclass hooks
 
@@ -310,7 +351,7 @@ class BaseTrainer:
             ok, grad_norm, step0, grads, new_params, updates,
             spectral=new_vars_G.get("spectral"),
             ema=state.get("ema_G") if self.model_average else None)
-        return state, losses, health
+        return self._constrain_state(state), losses, health
 
     def _dis_step_fn(self, state, data):
         step0 = state["step_D"]
@@ -341,7 +382,7 @@ class BaseTrainer:
         health = self._audit_health(
             ok, grad_norm, step0, grads, new_params, updates,
             spectral=new_vars_D.get("spectral"))
-        return state, losses, health
+        return self._constrain_state(state), losses, health
 
     # ------------------------------------------------------------ lifecycle
 
@@ -741,6 +782,13 @@ class BaseTrainer:
             current_epoch, current_iteration,
             async_save=bool(cfg_get(self.cfg.trainer, "async_checkpoint",
                                     False)))
+        # Partition descriptor sidecar: restore compares it against the
+        # live plan and reshards (jax.device_put) on any mesh-shape /
+        # sharding-policy change instead of crashing or silently
+        # replicating (see load_checkpoint).
+        if self.partition.active:
+            ckpt_lib.write_partition_sidecar(path,
+                                             self.partition.describe())
         # Recalibrated EMA BN stats ride alongside (a sibling file keeps
         # the state tree's structure stable across checkpoint versions);
         # the reference persists them inside the averaged model's buffers.
@@ -785,6 +833,7 @@ class BaseTrainer:
                 self.state["vars_D"] = restored["vars_D"]
             if "ema_G" in restored:
                 self.state["ema_G"] = restored["ema_G"]
+        self._reshard_restored_state(checkpoint_path)
         bn_path = str(checkpoint_path) + ".ema_bn.pkl"
         if os.path.exists(bn_path):
             import pickle
@@ -793,6 +842,31 @@ class BaseTrainer:
                 self._ema_batch_stats = pickle.load(f)
         print(f"Done with loading the checkpoint (resume={bool(resume)}).")
         return True
+
+    def _reshard_restored_state(self, checkpoint_path):
+        """Re-place a restored state under the CURRENT partition plan.
+
+        ``load_checkpoint`` hands back host arrays (layout-agnostic by
+        design), so a checkpoint written on one mesh shape loads on any
+        other: here they are committed under the live plan's
+        NamedShardings via ``jax.device_put`` — orbax never sees a
+        spec mismatch, nothing silently replicates, and the step
+        programs meet their expected layout on the first post-restore
+        call. A saved-vs-current descriptor difference (mesh shape,
+        sharding knobs, plan on/off) is surfaced as a ``ckpt/reshard``
+        telemetry meta event."""
+        saved = ckpt_lib.read_partition_sidecar(checkpoint_path)
+        current = self.partition.describe() if self.partition.active \
+            else None
+        if saved != current and (saved is not None
+                                 or current is not None):
+            telemetry.get().meta("ckpt/reshard", saved=saved,
+                                 current=current,
+                                 checkpoint=str(checkpoint_path))
+            print(f"Resharding restored checkpoint: saved partition "
+                  f"{saved} -> current {current}")
+        if self.partition.active:
+            self.state = self._place_state(self.state)
 
     # ------------------------------------------------------------ inference
 

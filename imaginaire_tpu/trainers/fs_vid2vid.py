@@ -143,12 +143,19 @@ class Trainer(Vid2VidTrainer):
         inv_mask = jax.tree_util.tree_map(lambda m: not m, mask)
         # masked() leaves unmasked updates untouched — zero them
         # explicitly so frozen params stay frozen
+        from imaginaire_tpu.optim import init_optimizer_state
+
         self.tx_G = optax.chain(
             optax.masked(optax.set_to_zero(), inv_mask),
             optax.masked(self.tx_G, mask))
-        self.state["opt_G"] = self.tx_G.init(params_G)
-        self.state["opt_D"] = self.tx_D.init(
-            self.state["vars_D"]["params"])
+        self.state["opt_G"] = init_optimizer_state(self.tx_G, params_G,
+                                                   self.partition)
+        self.state["opt_D"] = init_optimizer_state(
+            self.tx_D, self.state["vars_D"]["params"], self.partition)
+        # the masked chain changed the opt_G tree STRUCTURE: rebuild the
+        # partition shardings (and re-place) before the re-traced
+        # programs constrain against them
+        self.state = self._place_state(self.state)
         # the step programs closed over the old optimizer: drop the
         # cached executables and re-trace. This is the one legitimate
         # re-jit in the codebase — the ledger records it as expected

@@ -87,6 +87,10 @@ class Trainer(SPADETrainer):
                 (get_paired_input_label_channel_number(self.cfg.data),
                  cfg_get(enc_cfg, "num_clusters", 10),
                  enc_cfg.num_feat_channels), jnp.float32)
+            # the partition shardings super() computed predate the new
+            # leaf — rebuild them so the plan's structure matches
+            self.state = self._place_state(state)
+            return self.state
         return state
 
     def _pre_save_checkpoint(self):

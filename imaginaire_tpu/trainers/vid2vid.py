@@ -36,6 +36,7 @@ from imaginaire_tpu.losses import (
 )
 from imaginaire_tpu.losses.flow import masked_l1_loss
 from imaginaire_tpu.model_utils.fs_vid2vid import concat_frames, skip_stride_span
+from imaginaire_tpu.optim import init_optimizer_state
 from imaginaire_tpu.trainers.base import MUTABLE, BaseTrainer
 from imaginaire_tpu.utils.misc import numeric_only, to_device
 from imaginaire_tpu.utils.model_average import ema_init, ema_update
@@ -241,7 +242,8 @@ class Trainer(BaseTrainer):
             {"params": k_g, "noise": k_noise}, data_t))
         state: Dict[str, Any] = {
             "vars_G": vars_G,
-            "opt_G": self.tx_G.init(vars_G["params"]),
+            "opt_G": init_optimizer_state(self.tx_G, vars_G["params"],
+                                          self.partition),
             "step": jnp.zeros((), jnp.int32),
             "rng_G": k_rg,
             "rng_D": k_rd,
@@ -261,15 +263,18 @@ class Trainer(BaseTrainer):
             {"params": k_d, "dropout": k_d}, data_t, fake_out,
             self._stacks_list(stacks)))
         state["vars_D"] = vars_D
-        state["opt_D"] = self.tx_D.init(vars_D["params"])
+        state["opt_D"] = init_optimizer_state(self.tx_D, vars_D["params"],
+                                              self.partition)
         state["step_D"] = jnp.zeros((), jnp.int32)
         if self.model_average:
             state["ema_G"] = ema_init(
                 vars_G["params"], vars_G.get("spectral"),
                 remove_sn=self.model_average_remove_sn)
             state["num_ema_updates"] = jnp.zeros((), jnp.int32)
-        self.state = state
-        return state
+        # 2-D partition plan (parallel/partition.py): commit the state
+        # under its shardings before the first per-frame program compiles
+        self.state = self._place_state(state)
+        return self.state
 
     def _stacks_list(self, stacks):
         """dict {'s0': (real, fake)} -> list indexed by scale, None when
@@ -471,7 +476,8 @@ class Trainer(BaseTrainer):
             ok, grad_norm, step0, grads, new_params, updates,
             spectral=new_vars_G.get("spectral"),
             ema=state.get("ema_G") if self.model_average else None)
-        return state, losses, jax.lax.stop_gradient(fake), health
+        return (self._constrain_state(state), losses,
+                jax.lax.stop_gradient(fake), health)
 
     def _vid_dis_step_fn(self, state, data):
         step0 = state["step_D"]
@@ -504,7 +510,7 @@ class Trainer(BaseTrainer):
         health = self._audit_health(
             ok, grad_norm, step0, grads, new_params, updates,
             spectral=new_vars_D.get("spectral"))
-        return state, losses, health
+        return self._constrain_state(state), losses, health
 
     # ------------------------------------------------------------- rollout
 

@@ -171,6 +171,42 @@ def latest_checkpoint_path(logdir):
     return path if os.path.exists(path) else None
 
 
+def write_partition_sidecar(path, descriptor):
+    """Persist the saving run's partition-plan descriptor (mesh axes/
+    shape + update-state sharding knobs, see
+    ``PartitionPlan.describe``) as a ``<ckpt>.partition.json`` sibling —
+    like the ``.ema_bn.pkl`` sibling, a sidecar keeps the state tree's
+    structure stable across checkpoint versions. Master-only; a missing
+    sidecar means 'saved replicated' (pre-ISSUE-6 checkpoints)."""
+    import json
+
+    if not is_master():
+        return
+    try:
+        with open(str(path) + ".partition.json", "w") as f:
+            json.dump(descriptor, f, indent=1, default=str)
+    except Exception as e:  # noqa: BLE001 — a sidecar must never fail a save
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "partition sidecar write failed: %s", e)
+
+
+def read_partition_sidecar(path):
+    """The saved partition descriptor, or None (replicated / legacy)."""
+    import json
+    import os as _os
+
+    sidecar = str(path) + ".partition.json"
+    if not _os.path.exists(sidecar):
+        return None
+    try:
+        with open(sidecar) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def load_checkpoint(path, target=None):
     """Restore a state pytree; ``target`` gives structure/dtypes.
 

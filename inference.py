@@ -15,8 +15,8 @@ from imaginaire_tpu.config import Config, cfg_get
 from imaginaire_tpu.data import get_test_dataloader
 from imaginaire_tpu.parallel.mesh import (
     honor_platform_env,
-    create_mesh,
     master_only_print as print,  # noqa: A001
+    mesh_from_config,
     set_mesh,
 )
 from imaginaire_tpu.registry import resolve
@@ -39,8 +39,10 @@ def main():
     honor_platform_env()
     args = parse_args()
     cfg = Config(args.config)
-    set_mesh(create_mesh(tuple(cfg.runtime.mesh.axes),
-                         cfg.runtime.mesh.shape))
+    # cfg.parallel.mesh_shape wins over the legacy runtime.mesh block
+    # (checkpoints restore shard-aware either way — trainers reshard on
+    # load via the partition sidecar)
+    set_mesh(mesh_from_config(cfg))
     date_uid, logdir = init_logging(args.config, args.logdir)
     make_logging_dir(logdir)
     cfg.logdir = logdir

@@ -1,0 +1,464 @@
+"""2-D (data x model) partition plan + cross-replica sharded update
+state (ISSUE 6, parallel/partition.py).
+
+Covers: logical-axis rule resolution over every family's REAL param
+tree (via jax.eval_shape — no init compute), sharded-optimizer vs
+replicated-optimizer step parity on a virtual 4-device mesh (bit parity
+under sgd, fp32 tolerance under adam+EMA over 3 steps), a
+zero-recompile assert across 3 steps under the 2-D mesh, the
+place_committed_batch 2-D divisibility contract, checkpoint restore
+onto a different mesh shape (reshard, ckpt/reshard meta), and the
+dead-model-axis warning.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import __graft_entry__ as ge
+from imaginaire_tpu.config import Config
+from imaginaire_tpu.parallel.mesh import (
+    create_mesh,
+    mesh_from_config,
+    set_mesh,
+)
+from imaginaire_tpu.parallel.partition import (
+    DEFAULT_RULES,
+    PartitionPlan,
+    leaf_logical_axes,
+    leaf_partition_spec,
+    per_device_tree_bytes,
+    state_bytes_report,
+)
+from imaginaire_tpu.parallel.sharding import place_committed_batch
+from imaginaire_tpu.registry import resolve
+
+CONFIGS = os.path.join(os.path.dirname(__file__), "..", "configs",
+                       "unit_test")
+
+
+def _mesh_2x2():
+    return create_mesh(("data", "model"), (2, 2),
+                       devices=np.array(jax.devices()[:4]))
+
+
+def _mesh_4x1():
+    return create_mesh(("data", "model"), (4, 1),
+                       devices=np.array(jax.devices()[:4]))
+
+
+def _tiny_trainer(mesh_shape=None, opt=None, model_average=True,
+                  min_shard_size=8):
+    cfg = ge._tiny_cfg()
+    cfg.trainer.model_average = model_average
+    cfg.diagnostics.dg_ratio_warn_low = 0.0
+    cfg.diagnostics.dg_ratio_warn_high = 1e9
+    if opt is not None:
+        cfg.gen_opt.type = opt
+        cfg.dis_opt.type = opt
+    if mesh_shape is not None:
+        cfg.parallel.mesh_shape = dict(mesh_shape)
+        cfg.parallel.min_shard_size = min_shard_size
+    return resolve(cfg.trainer.type, "Trainer")(cfg), cfg
+
+
+class TestRuleResolution:
+    def test_logical_axes(self):
+        assert leaf_logical_axes("kernel", (3, 3, 64, 128)) == \
+            ("conv_kh", "conv_kw", "conv_in", "conv_out")
+        assert leaf_logical_axes("kernel", (64, 128)) == \
+            ("dense_in", "dense_out")
+        assert leaf_logical_axes("embedding", (10, 16)) == \
+            ("embed_vocab", "embed_features")
+        assert leaf_logical_axes("bias", (128,)) == ("features",)
+        assert leaf_logical_axes("count", ()) == ()
+        # vmapped hyper-conv kernels keep leading stack dims replicated
+        assert leaf_logical_axes("kernel", (4, 3, 3, 8, 16))[0] == "stack"
+
+    def test_out_channel_preferred_in_channel_fallback(self):
+        sizes = {"data": 2, "model": 2}
+        # wide out -> model on out
+        spec = leaf_partition_spec("kernel", (3, 3, 64, 128), sizes,
+                                   min_shard_size=8)
+        assert tuple(spec) == (None, None, None, "model")
+        # narrow/indivisible out (RGB conv) -> model falls back to in
+        spec = leaf_partition_spec("kernel", (3, 3, 64, 3), sizes,
+                                   min_shard_size=8)
+        assert tuple(spec) == (None, None, "model")
+        # below the channel threshold -> replicated
+        spec = leaf_partition_spec("kernel", (3, 3, 4, 4), sizes,
+                                   min_shard_size=8)
+        assert tuple(spec) == ()
+
+    def test_update_axis_on_first_free_dim(self):
+        sizes = {"data": 2, "model": 2}
+        spec = leaf_partition_spec("kernel", (3, 3, 64, 128), sizes,
+                                   min_shard_size=8, update_axis="data")
+        assert tuple(spec) == (None, None, "data", "model")
+        spec = leaf_partition_spec("bias", (128,), sizes,
+                                   min_shard_size=8, update_axis="data")
+        assert tuple(spec) == ("data",)
+        # scalars (adam count, madam p_max) stay replicated
+        spec = leaf_partition_spec("count", (), sizes,
+                                   min_shard_size=8, update_axis="data")
+        assert tuple(spec) == ()
+
+    # every family's real generator param tree: eval_shape the flax init
+    # (no compute), resolve the rules, and demand full coverage — every
+    # leaf resolves to a spec, and no wide conv above the channel
+    # threshold is left replicated on a live model axis
+    FAMILY_DATA = {
+        "spade": lambda rng: {
+            "images": rng.rand(1, 256, 256, 3).astype(np.float32),
+            "label": (rng.rand(1, 256, 256, 14) > 0.9).astype(np.float32)},
+        "pix2pixHD": lambda rng: {
+            "images": rng.rand(1, 256, 256, 3).astype(np.float32),
+            "label": (rng.rand(1, 256, 256, 14) > 0.9).astype(np.float32),
+            "instance_maps": rng.rand(1, 256, 256, 1).astype(np.float32)},
+        "unit": lambda rng: {
+            "images_a": rng.rand(1, 64, 64, 3).astype(np.float32),
+            "images_b": rng.rand(1, 64, 64, 3).astype(np.float32)},
+        "munit": lambda rng: {
+            "images_a": rng.rand(1, 64, 64, 3).astype(np.float32),
+            "images_b": rng.rand(1, 64, 64, 3).astype(np.float32)},
+        "funit": lambda rng: {
+            "images_content": rng.rand(1, 64, 64, 3).astype(np.float32),
+            "labels_content": np.asarray([1], np.int32),
+            "images_style": rng.rand(1, 64, 64, 3).astype(np.float32),
+            "labels_style": np.asarray([1], np.int32)},
+    }
+
+    @pytest.mark.parametrize("family", sorted(FAMILY_DATA))
+    def test_family_param_tree_coverage(self, family, rng):
+        cfg = Config(os.path.join(CONFIGS, f"{family}.yaml"))
+        net_G = resolve(cfg.gen.type, "Generator")(cfg.gen, cfg.data)
+        data = self.FAMILY_DATA[family](rng)
+        shapes = jax.eval_shape(
+            lambda d: net_G.init({"params": jax.random.PRNGKey(0),
+                                  "noise": jax.random.PRNGKey(1)},
+                                 d, training=True), data)
+        params = shapes["params"]
+        mesh = _mesh_2x2()
+        plan = PartitionPlan(
+            {"parallel": {"mesh_shape": {"data": 2, "model": 2},
+                          "min_shard_size": 16}}, mesh=mesh)
+        hits = [0]
+        specs = plan.param_specs(params, _model_hits=hits)
+        flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+        flat_s = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda s: type(s).__name__ == "PartitionSpec")
+        # every leaf resolved to a spec
+        assert len(flat_p) == len(flat_s)
+        wide_unsharded = []
+        for (path, leaf), spec in zip(flat_p, flat_s):
+            name = str(path[-1].key) if hasattr(path[-1], "key") else ""
+            if name == "kernel" and leaf.ndim >= 2:
+                widths = [d for d in leaf.shape[-2:]
+                          if d >= 16 and d % 2 == 0]
+                if widths and "model" not in tuple(spec):
+                    wide_unsharded.append(
+                        (jax.tree_util.keystr(path), leaf.shape))
+        assert not wide_unsharded, \
+            f"{family}: wide convs left replicated: {wide_unsharded[:8]}"
+        assert hits[0] > 0, f"{family}: no leaf uses the model axis"
+
+    @pytest.mark.parametrize("family,yaml",
+                             [("vid2vid", "vid2vid_street.yaml"),
+                              ("fs_vid2vid", "fs_vid2vid.yaml")])
+    def test_video_family_param_tree_coverage(self, family, yaml, rng):
+        """The video generators (flow-warp, hyper-weight) init per
+        frame; eval_shape their full init_all tree and demand the same
+        rule coverage."""
+        from imaginaire_tpu.utils.data import (
+            get_paired_input_label_channel_number,
+        )
+
+        cfg = Config(os.path.join(CONFIGS, yaml))
+        net_G = resolve(cfg.gen.type, "Generator")(cfg.gen, cfg.data)
+        n_lab = get_paired_input_label_channel_number(cfg.data)
+        data_t = {
+            "label": (rng.rand(1, 64, 64, n_lab) > 0.9).astype(np.float32),
+            "image": rng.rand(1, 64, 64, 3).astype(np.float32) * 2 - 1,
+        }
+        if family == "fs_vid2vid":
+            data_t["ref_images"] = rng.rand(1, 1, 64, 64, 3).astype(
+                np.float32) * 2 - 1
+            data_t["ref_labels"] = (rng.rand(1, 1, 64, 64, n_lab) > 0.9
+                                    ).astype(np.float32)
+        shapes = jax.eval_shape(
+            lambda d: net_G.init({"params": jax.random.PRNGKey(0),
+                                  "noise": jax.random.PRNGKey(1)},
+                                 d, training=True, init_all=True), data_t)
+        params = shapes["params"]
+        plan = PartitionPlan(
+            {"parallel": {"mesh_shape": {"data": 2, "model": 2},
+                          "min_shard_size": 16}}, mesh=_mesh_2x2())
+        hits = [0]
+        specs = plan.param_specs(params, _model_hits=hits)
+        assert len(jax.tree_util.tree_leaves(params)) == len(
+            jax.tree_util.tree_leaves(
+                specs,
+                is_leaf=lambda s: type(s).__name__ == "PartitionSpec"))
+        assert hits[0] > 0, f"{family}: no leaf uses the model axis"
+
+
+class TestShardedStepParity:
+    """Sharded-optimizer step vs replicated step on the virtual 4-device
+    mesh. Under sgd the two are BIT-identical (the update is lr*g, so
+    the only differences would be real partitioning bugs). Under adam
+    the collective reduction order (reduce-scatter+all-gather vs
+    all-reduce) perturbs grads at bit level and the rsqrt normalization
+    amplifies that to update scale for near-zero grads — so the
+    adam/EMA path asserts fp32-tolerance parity over 3 full steps (the
+    acceptance criterion) instead of bit equality."""
+
+    def _one_step(self, mesh, mesh_shape, opt, bs, steps=1):
+        set_mesh(mesh)
+        trainer, _ = _tiny_trainer(mesh_shape=mesh_shape, opt=opt)
+        batch = jax.tree_util.tree_map(
+            np.asarray, ge._tiny_batch(bs, h=64, w=64))
+        trainer.init_state(jax.random.PRNGKey(0), batch)
+        b = place_committed_batch(batch, mesh=mesh)
+        hist = []
+        for _ in range(steps):
+            d = trainer.dis_update(b)
+            g = trainer.gen_update(b)
+            hist.append((float(d["total"]), float(g["total"])))
+        return trainer, hist
+
+    @pytest.mark.slow
+    def test_sgd_bit_parity_zero1(self):
+        """Pure cross-replica update-state sharding ((4,1): no model
+        axis) must reproduce the replicated optimizer step bit for
+        bit."""
+        mesh = _mesh_4x1()
+        t_rep, h_rep = self._one_step(mesh, None, "sgd", 4)
+        t_shd, h_shd = self._one_step(
+            mesh, {"data": 4, "model": 1}, "sgd", 4)
+        assert t_shd.partition.active and not t_rep.partition.active
+        assert h_rep == h_shd
+        for key in ("vars_G", "vars_D"):
+            rep = jax.device_get(t_rep.state[key]["params"])
+            shd = jax.device_get(t_shd.state[key]["params"])
+            for a, b in zip(jax.tree_util.tree_leaves(rep),
+                            jax.tree_util.tree_leaves(shd)):
+                np.testing.assert_array_equal(a, b)
+        # sgd is stateless (no moments) — the EMA tree is the update
+        # state here, and it really is sharded (<1/2 resident per chip)
+        report = state_bytes_report(t_shd.state)
+        assert report["ema_G"]["per_device_bytes"] < \
+            0.5 * report["ema_G"]["global_bytes"]
+
+    @pytest.mark.slow
+    def test_adam_ema_three_step_fp32_parity_and_zero_recompiles(self):
+        """Full 2-D plan ((2,2): model-sharded convs + data-sharded
+        adam moments + EMA): 3-step losses match the replicated run to
+        fp32 tolerance, params stay close, and the warm loop holds ONE
+        executable per program (zero recompiles)."""
+        from imaginaire_tpu.telemetry import xla_obs
+
+        mesh = _mesh_2x2()
+        t_rep, h_rep = self._one_step(mesh, None, None, 2, steps=3)
+        t_shd, h_shd = self._one_step(
+            mesh, {"data": 2, "model": 2}, None, 2, steps=3)
+        np.testing.assert_allclose(np.asarray(h_shd), np.asarray(h_rep),
+                                   rtol=5e-3)
+        rep = jax.device_get(t_rep.state["vars_G"]["params"])
+        shd = jax.device_get(t_shd.state["vars_G"]["params"])
+        for a, b in zip(jax.tree_util.tree_leaves(rep),
+                        jax.tree_util.tree_leaves(shd)):
+            np.testing.assert_allclose(a, b, atol=5e-3)
+        # zero-recompile contract across the 3 sharded steps: one
+        # fingerprint per program, no counted recompiles
+        assert t_shd._jit_gen_step._cache_size() == 1
+        assert t_shd._jit_dis_step._cache_size() == 1
+        assert xla_obs.ledger().recompiles == 0
+        # EMA + moments shard over data; params replicate over data but
+        # shard wide channels over model
+        ema_leaf = jax.tree_util.tree_leaves(t_shd.state["ema_G"])[0]
+        assert "data" in jax.tree_util.tree_flatten(
+            tuple(ema_leaf.sharding.spec))[0] or \
+            tuple(ema_leaf.sharding.spec) != ()
+        report = state_bytes_report(t_shd.state)
+        for key in ("opt_G", "ema_G"):
+            assert report[key]["per_device_bytes"] < \
+                0.75 * report[key]["global_bytes"], report
+
+
+class TestPlaceCommittedBatch2D:
+    def test_bs2_commits_sharded_on_2x2(self):
+        """Satellite: batch divisibility is judged against the DATA
+        axis size (2), not mesh.size (4) — bs2 on a (2,2) mesh must
+        commit sharded, not fall back to uncommitted transfer."""
+        mesh = _mesh_2x2()
+        set_mesh(mesh)
+        batch = {"images": np.zeros((2, 8, 8, 3), np.float32)}
+        out = place_committed_batch(batch, mesh=mesh)
+        spec = out["images"].sharding.spec
+        assert tuple(spec)[0] == "data", spec
+        assert out["images"].sharding.mesh.shape["model"] == 2
+
+    def test_indivisible_bs_falls_back(self):
+        mesh = _mesh_2x2()
+        batch = {"images": np.zeros((3, 8, 8, 3), np.float32)}
+        out = place_committed_batch(batch, mesh=mesh)
+        # bs3 % data(2) != 0 -> uncommitted placement, not a crash
+        assert not isinstance(getattr(out["images"], "sharding", None),
+                              type(None)) or True
+        from jax.sharding import NamedSharding
+
+        sh = out["images"].sharding
+        assert not (isinstance(sh, NamedSharding)
+                    and tuple(sh.spec)[:1] == ("data",))
+
+    def test_axisless_mesh_replicates(self):
+        mesh = create_mesh(("model",), (4,),
+                           devices=np.array(jax.devices()[:4]))
+        batch = {"images": np.zeros((4, 8, 8, 3), np.float32)}
+        out = place_committed_batch(batch, mesh=mesh)  # no 'data' axis
+        assert out["images"].shape == (4, 8, 8, 3)
+
+
+class TestMeshFromConfig:
+    def test_parallel_group_wins(self):
+        cfg = Config()
+        cfg.parallel.mesh_shape = {"data": 2, "model": 2}
+        mesh = mesh_from_config(cfg, devices=np.array(jax.devices()[:4]))
+        assert dict(mesh.shape) == {"data": 2, "model": 2}
+
+    def test_legacy_runtime_mesh_fallback(self):
+        cfg = Config()
+        mesh = mesh_from_config(cfg, devices=np.array(jax.devices()))
+        assert tuple(mesh.axis_names) == ("data",)
+        assert mesh.size == len(jax.devices())
+
+    def test_dead_model_axis_warns(self, caplog):
+        """Satellite: a model axis of size >1 that no rule consumes is
+        named loudly instead of silently replicating."""
+        import logging
+
+        mesh = _mesh_2x2()
+        plan = PartitionPlan(
+            {"parallel": {"mesh_shape": {"data": 2, "model": 2},
+                          # threshold above every leaf width -> no match
+                          "min_shard_size": 10_000_000}}, mesh=mesh)
+        state = {"vars_G": {"params": {"conv": {
+            "kernel": jnp.zeros((3, 3, 16, 32))}}},
+            "step": jnp.zeros((), jnp.int32)}
+        with caplog.at_level(logging.WARNING,
+                             logger="imaginaire_tpu.parallel.partition"):
+            plan.state_specs(state)
+        assert any("model axis" in r.message for r in caplog.records)
+        # and only once
+        caplog.clear()
+        with caplog.at_level(logging.WARNING,
+                             logger="imaginaire_tpu.parallel.partition"):
+            plan.state_specs(state)
+        assert not any("model axis" in r.message for r in caplog.records)
+
+    def test_default_rules_cover_snippets_pattern(self):
+        # the DEFAULT_RULES table maps channel-ish axes to 'model' and
+        # keeps batch-ish/feature axes unsharded, mirroring the
+        # SNIPPETS [2]/[3] pattern
+        assert DEFAULT_RULES["conv_out"] == "model"
+        assert DEFAULT_RULES["features"] is None
+        assert DEFAULT_RULES["embed_vocab"] is None
+
+
+@pytest.mark.slow
+class TestCheckpointReshard:
+    def test_restore_onto_different_mesh_reshards(self, tmp_path):
+        """Satellite: a checkpoint saved under one mesh shape restores
+        under another — resharded via jax.device_put, with a
+        ckpt/reshard telemetry meta event — instead of crashing or
+        silently replicating."""
+        from imaginaire_tpu import telemetry
+
+        mesh = _mesh_2x2()
+        set_mesh(mesh)
+        trainer, cfg = _tiny_trainer(mesh_shape={"data": 2, "model": 2})
+        cfg.logdir = str(tmp_path)
+        trainer.cfg.logdir = str(tmp_path)
+        batch = jax.tree_util.tree_map(
+            np.asarray, ge._tiny_batch(2, h=64, w=64))
+        trainer.init_state(jax.random.PRNGKey(0), batch)
+        path = trainer.save_checkpoint(0, 1)
+        assert os.path.exists(path + ".partition.json")
+        saved_desc = json.load(open(path + ".partition.json"))
+        assert saved_desc["mesh_shape"] == [2, 2]
+
+        # restore onto a (4,1) mesh (different shape, ZeRO-only plan)
+        mesh41 = _mesh_4x1()
+        set_mesh(mesh41)
+        tdir = str(tmp_path / "telemetry")
+        tm = telemetry.configure(logdir=tdir, enabled=True,
+                                 sinks=("jsonl",), flush_every_n_steps=1)
+        trainer2, cfg2 = _tiny_trainer(mesh_shape={"data": 4, "model": 1})
+        trainer2.cfg.logdir = str(tmp_path)
+        trainer2.init_state(jax.random.PRNGKey(1), batch)
+        assert trainer2.load_checkpoint(path, resume=True)
+        # params identical after the mesh change...
+        a = jax.device_get(trainer.state["vars_G"]["params"])
+        b = jax.device_get(trainer2.state["vars_G"]["params"])
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)):
+            np.testing.assert_array_equal(x, y)
+        # ...and the update state is committed under the NEW plan
+        mu = jax.tree_util.tree_leaves(trainer2.state["opt_G"])[1]
+        assert mu.sharding.mesh.shape["data"] == 4
+        tm.shutdown()
+        events = [json.loads(line) for line in
+                  open(os.path.join(tdir, "telemetry.jsonl"))]
+        reshard = [e for e in events
+                   if e.get("kind") == "meta"
+                   and e.get("name") == "ckpt/reshard"]
+        assert reshard, "ckpt/reshard meta event missing"
+        assert reshard[0]["saved"]["mesh_shape"] == [2, 2]
+        assert reshard[0]["current"]["mesh_shape"] == [4, 1]
+
+    def test_replicated_checkpoint_loads_into_plan(self, tmp_path):
+        """Legacy (no-sidecar, replicated) checkpoints restore into an
+        active plan: arrays come back resharded, event emitted."""
+        mesh = _mesh_2x2()
+        set_mesh(mesh)
+        t_rep, cfg = _tiny_trainer(mesh_shape=None)
+        t_rep.cfg.logdir = str(tmp_path)
+        batch = jax.tree_util.tree_map(
+            np.asarray, ge._tiny_batch(2, h=64, w=64))
+        t_rep.init_state(jax.random.PRNGKey(0), batch)
+        path = t_rep.save_checkpoint(0, 1)
+        assert not os.path.exists(path + ".partition.json")
+
+        t_shd, _ = _tiny_trainer(mesh_shape={"data": 2, "model": 2})
+        t_shd.cfg.logdir = str(tmp_path)
+        t_shd.init_state(jax.random.PRNGKey(1), batch)
+        assert t_shd.load_checkpoint(path, resume=True)
+        mu = jax.tree_util.tree_leaves(t_shd.state["opt_G"])[1]
+        spec = tuple(mu.sharding.spec)
+        assert "data" in spec or "model" in spec
+
+
+class TestPerDeviceBytes:
+    def test_replicated_equals_global(self):
+        mesh = _mesh_2x2()
+        x = jax.device_put(
+            np.zeros((8, 8), np.float32),
+            jax.sharding.NamedSharding(mesh,
+                                       jax.sharding.PartitionSpec()))
+        assert per_device_tree_bytes({"x": x}) == 8 * 8 * 4
+
+    def test_sharded_divides(self):
+        mesh = _mesh_2x2()
+        x = jax.device_put(
+            np.zeros((8, 8), np.float32),
+            jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec("data", "model")))
+        assert per_device_tree_bytes({"x": x}) == 8 * 8 * 4 // 4
+
+    def test_host_arrays_count_global(self):
+        assert per_device_tree_bytes(
+            {"x": np.zeros((4,), np.float32)}) == 16

@@ -14,7 +14,7 @@ import jax
 from imaginaire_tpu import telemetry
 from imaginaire_tpu.config import Config, cfg_get
 from imaginaire_tpu.data import get_train_and_val_dataloader
-from imaginaire_tpu.parallel.mesh import create_mesh, master_only_print as print, set_mesh, honor_platform_env
+from imaginaire_tpu.parallel.mesh import mesh_from_config, master_only_print as print, set_mesh, honor_platform_env
 from imaginaire_tpu.registry import resolve
 from imaginaire_tpu.utils.logging_utils import init_logging, make_logging_dir
 
@@ -56,7 +56,10 @@ def main():
         print("--debug-nans: jax_debug_nans on, step-buffer donation off "
               "(expect higher memory + much slower steps)")
 
-    set_mesh(create_mesh(tuple(cfg.runtime.mesh.axes), cfg.runtime.mesh.shape))
+    # single mesh entry point: cfg.parallel.mesh_shape (2-D data x model
+    # + sharded update state, parallel/partition.py) wins over the
+    # legacy runtime.mesh block
+    set_mesh(mesh_from_config(cfg))
     date_uid, logdir = init_logging(args.config, args.logdir)
     make_logging_dir(logdir)
     cfg.logdir = logdir
