@@ -60,7 +60,40 @@ def _leg_summary(tm, xla_mark=None):
                              for name, row in s.get("phases", {}).items()}
     if xla_mark is not None:
         out["xla"] = _xla_leg(xla_mark)
+    out["resilience"] = _resilience_leg()
     return out
+
+
+def _resilience_leg():
+    """Fault-tolerance counters for a bench leg (ISSUE 7): retries,
+    checkpoint fallbacks/quarantines and corrupt flow shards observed
+    during the leg. All zero on a healthy leg — the point of recording
+    them is that a regression (flaky store, corrupt cache) shows up in
+    the bench JSON instead of hiding in warning logs."""
+    counters = {}
+    try:
+        from imaginaire_tpu import telemetry as _tm
+
+        with _tm.get()._lock:
+            events = list(_tm.get()._events)
+        for ev in events:
+            name = str(ev.get("name", ""))
+            if ev.get("kind") == "counter" and (
+                    name.startswith("resilience/")
+                    or name == "flow_cache/corrupt_shards"):
+                counters[name] = ev.get("value")
+    except Exception:  # noqa: BLE001 — bench accounting is best-effort
+        pass
+    return {
+        "retries": sum(int(v or 0) for k, v in counters.items()
+                       if k.startswith("resilience/retry/")),
+        "ckpt_fallbacks": int(counters.get("resilience/ckpt_fallbacks",
+                                           0) or 0),
+        "ckpt_quarantined": int(
+            counters.get("resilience/ckpt_quarantined", 0) or 0),
+        "corrupt_flow_shards": int(
+            counters.get("flow_cache/corrupt_shards", 0) or 0),
+    }
 
 
 def _parallel_leg(trainer=None):

@@ -298,6 +298,43 @@ def default_config():
             dir=None,  # None -> <logdir>/flow_cache
             store_dtype="float16",  # on-disk flow dtype (conf is uint8)
         ),
+        # -- fault tolerance (resilience/, ISSUE 7). checksum: per-leaf
+        # crc32 checksums of the saved state ride the checkpoint sidecar
+        # (one device_get of the addressable leaves per save — see
+        # PROFILE.md for the cost); verify_on_load replays them on
+        # restore and a mismatch quarantines the checkpoint (*.corrupt)
+        # and falls back to the newest verifiable one.
+        # emergency_checkpoint arms the SIGTERM preemption guard in
+        # train.py: the in-flight step drains into a synchronous
+        # emergency checkpoint within emergency_deadline_s (past the
+        # deadline the process force-exits with code 75/EX_TEMPFAIL —
+        # the supervisor's SIGKILL was coming anyway). retry bounds the
+        # backoff wrapper for transient IO on checkpoint commit /
+        # pointer / flow-cache shards (resilience/retry.py; counted in
+        # resilience/retry/* telemetry).
+        resilience=AttrDict(
+            enabled=True,
+            checksum=True,
+            verify_on_load=True,
+            emergency_checkpoint=True,
+            emergency_deadline_s=60.0,
+            retry=AttrDict(retries=3, backoff_s=0.1, max_backoff_s=2.0),
+        ),
+        # -- chaos harness (resilience/chaos.py): deterministic fault
+        # injection at configured steps so the recovery paths above stay
+        # tested product code (the dryrun spade_chaos leg and
+        # tests/test_resilience.py drive these). All *_at_step knobs are
+        # one-shot; io_error_site picks which IO path the transient
+        # error hits (flow_store | loader). Off by default — never
+        # enable in a run you care about.
+        chaos=AttrDict(
+            enabled=False,
+            sigterm_at_step=None,
+            corrupt_checkpoint_at_step=None,
+            nan_batch_at_step=None,
+            io_error_at_step=None,
+            io_error_site="flow_store",
+        ),
         # -- 2-D (data x model) parallelism (parallel/partition.py,
         # ISSUE 6). mesh_shape opts in: {"data": N, "model": M} (or an
         # [N, M] list aligned with axes) builds the 2-D mesh through

@@ -104,12 +104,24 @@ class DevicePrefetcher:
         self.axis = axis
         self._stats_lock = threading.Lock()
         self._stats = {}
+        self._drop_batches = 0  # fast_forward fallback (one-shot)
 
     # ------------------------------------------------- loader passthrough
 
     def set_epoch(self, epoch):
         if hasattr(self.loader, "set_epoch"):
             self.loader.set_epoch(epoch)
+
+    def fast_forward(self, n_batches):
+        """Mid-epoch resume: skip the first ``n_batches`` of the next
+        iteration pass. Delegates to the wrapped loader (no item is
+        loaded or transferred for the skipped prefix); loaders without
+        the knob fall back to a producer-side drop counter — batches
+        are produced then discarded before preprocess/transfer."""
+        if hasattr(self.loader, "fast_forward"):
+            self.loader.fast_forward(n_batches)
+        else:
+            self._drop_batches = max(int(n_batches), 0)
 
     def __len__(self):
         return len(self.loader)
@@ -171,6 +183,12 @@ class DevicePrefetcher:
             tm = telemetry.get()
             try:
                 source = iter(self.loader)
+                drop, self._drop_batches = self._drop_batches, 0
+                for _ in range(drop):
+                    try:
+                        next(source)
+                    except StopIteration:
+                        return
                 index = 0
                 while not stop.is_set():
                     t0 = time.perf_counter()

@@ -33,9 +33,37 @@ class DataLoader:
         # the items are sequential frames of one pinned video sequence
         # (the video eval harness shards by *sequence* instead)
         self.shard_by_process = shard_by_process
+        # one-shot batch skip for mid-epoch resume (resilience/, ISSUE
+        # 7): the next __iter__ drops the first N index-batches of the
+        # (deterministically seeded) epoch order without loading them
+        self._skip_batches = 0
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+
+    def fast_forward(self, n_batches):
+        """Skip the first ``n_batches`` of the NEXT epoch pass (one-shot).
+
+        The epoch order is a pure function of (seed, epoch), so the
+        skipped prefix is exactly the batches a killed run already
+        consumed — no item is loaded or decoded for them."""
+        self._skip_batches = max(int(n_batches), 0)
+
+    def _consume_skip(self, n_batches_total):
+        skip = min(self._skip_batches, n_batches_total)
+        self._skip_batches = 0
+        return skip
+
+    def _fetch(self, idx):
+        """One dataset item, with transient-IO retry (a flaky NFS read
+        must not kill a run) and the chaos harness's loader fault site."""
+        from imaginaire_tpu.resilience import chaos, retry_call
+
+        def _read():
+            chaos.get().maybe_io_error("loader")
+            return self.dataset[int(idx)]
+
+        return retry_call(_read, label="loader")
 
     def __len__(self):
         shards = get_world_size() if self.shard_by_process else 1
@@ -57,9 +85,13 @@ class DataLoader:
         if self.num_workers > 0:
             yield from self._iter_prefetch()
             return
+        order = self._order()
+        skip = self._consume_skip(len(order) // self.batch_size
+                                  if self.batch_size else 0)
+        order = order[skip * self.batch_size:]
         batch = []
-        for idx in self._order():
-            batch.append(self.dataset[int(idx)])
+        for idx in order:
+            batch.append(self._fetch(idx))
             if len(batch) == self.batch_size:
                 yield self._collate(batch)
                 batch = []
@@ -87,6 +119,7 @@ class DataLoader:
         if self.drop_last and batches and \
                 len(batches[-1]) < self.batch_size:
             batches.pop()
+        batches = batches[self._consume_skip(len(batches)):]
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_batches)
         stop = threading.Event()
         sentinel = object()
@@ -105,8 +138,8 @@ class DataLoader:
                     for idxs in batches:
                         if stop.is_set():
                             return
-                        futures = [pool.submit(self.dataset.__getitem__,
-                                               int(i)) for i in idxs]
+                        futures = [pool.submit(self._fetch, int(i))
+                                   for i in idxs]
                         put(self._collate([f.result() for f in futures]))
             except BaseException as e:  # forwarded to the consumer
                 put(e)

@@ -90,6 +90,43 @@ class HealthMonitor:
         self._snapshot_step = None
         self._triaged = False
 
+    # --------------------------------------------------------- run state
+
+    def state_dict(self):
+        """JSON-serializable monitor state for the checkpoint's runstate
+        sidecar (resilience/, ISSUE 7): a resumed run keeps its GAN
+        balance EWMA, breach counts and health history instead of
+        silently restarting them."""
+        return {
+            "dg_ratio_ewma": self.dg_ratio_ewma,
+            "dg_breaches": int(self.dg_breaches),
+            "in_breach": bool(self._in_breach),
+            "skip_count": int(self.skip_count),
+            "nonfinite_events": int(self.nonfinite_events),
+            "last_gan": dict(self._last_gan),
+            "history": list(self.history),
+        }
+
+    def load_state_dict(self, state):
+        """Restore ``state_dict`` output (missing keys keep defaults —
+        old sidecars stay loadable)."""
+        if not state:
+            return
+        if state.get("dg_ratio_ewma") is not None:
+            self.dg_ratio_ewma = float(state["dg_ratio_ewma"])
+        self.dg_breaches = int(state.get("dg_breaches",
+                                         self.dg_breaches))
+        self._in_breach = bool(state.get("in_breach", self._in_breach))
+        self.skip_count = int(state.get("skip_count", self.skip_count))
+        self.nonfinite_events = int(state.get("nonfinite_events",
+                                              self.nonfinite_events))
+        self._last_gan = {str(k): float(v) for k, v in
+                          (state.get("last_gan") or {}).items()}
+        history = state.get("history")
+        if history:
+            self.history.clear()
+            self.history.extend(history)
+
     # ------------------------------------------------------------ intake
 
     def observe(self, trainer, kind, losses, health, data, step):

@@ -156,6 +156,7 @@ class FlowCacheStore:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.corrupt_shards = 0
 
     def path(self, key):
         return os.path.join(self.root, key[:2], key + ".npz")
@@ -163,15 +164,59 @@ class FlowCacheStore:
     def has(self, key):
         return os.path.exists(self.path(key))
 
-    def get(self, key):
-        """(flow float32, conf float32) or None. IO/corruption degrade
-        to a miss — the teacher simply recomputes."""
-        path = self.path(key)
+    def _read(self, path):
+        """One shard read — the retried unit (transient OSErrors recover
+        on the next attempt) and the chaos harness's flow-store site."""
+        from imaginaire_tpu.resilience import chaos
+
+        chaos.get().maybe_io_error("flow_store")
+        with np.load(path) as npz:
+            return (npz["flow"].astype(np.float32),
+                    npz["conf"].astype(np.float32))
+
+    def _quarantine(self, path, error):
+        """A corrupt shard degrades to a miss ONCE: renamed to
+        ``*.corrupt`` so it is never re-read (and re-missed) every
+        epoch, counted in ``flow_cache/corrupt_shards``."""
+        from imaginaire_tpu import telemetry
+
+        with self._lock:
+            self.corrupt_shards += 1
+            count = self.corrupt_shards
         try:
-            with np.load(path) as npz:
-                flow = npz["flow"].astype(np.float32)
-                conf = npz["conf"].astype(np.float32)
-        except (OSError, KeyError, ValueError, EOFError):
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        logger.warning("flow cache: quarantined corrupt shard %s (%s)",
+                       path, error)
+        tm = telemetry.get()
+        if tm.enabled:
+            tm.counter("flow_cache/corrupt_shards", count)
+            tm.meta("flow_cache/corrupt_shard", shard=str(path),
+                    error=str(error)[:200])
+
+    def get(self, key):
+        """(flow float32, conf float32) or None. Transient IO retries
+        with bounded backoff (resilience/retry.py); a shard that still
+        fails — or fails to parse — is quarantined and degrades to a
+        miss (the teacher simply recomputes)."""
+        import zipfile
+
+        from imaginaire_tpu.resilience import retry_call
+
+        path = self.path(key)
+        if not os.path.exists(path):
+            with self._lock:
+                self.misses += 1
+            return None
+        try:
+            flow, conf = retry_call(self._read, path, label="flow_store")
+        except (OSError, KeyError, ValueError, EOFError,
+                zipfile.BadZipFile) as e:
+            self._quarantine(path, e)
             with self._lock:
                 self.misses += 1
             return None
@@ -180,14 +225,20 @@ class FlowCacheStore:
         return flow, conf
 
     def put(self, key, flow, conf):
+        from imaginaire_tpu.resilience import retry_call
+
         path = self.path(key)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         # np.savez appends '.npz' unless the name already ends with it
         tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp.npz"
-        try:
+
+        def _write():
             np.savez(tmp, flow=np.asarray(flow).astype(self.store_dtype),
                      conf=np.asarray(conf).astype(np.uint8))
             os.replace(tmp, path)
+
+        try:
+            retry_call(_write, label="flow_store_write")
         except OSError as e:
             logger.warning("flow cache write failed for %s: %s", path, e)
             try:
@@ -203,6 +254,7 @@ class FlowCacheStore:
         with self._lock:
             total = self.hits + self.misses
             return {"hits": self.hits, "misses": self.misses,
+                    "corrupt_shards": self.corrupt_shards,
                     "hit_rate": (self.hits / total) if total else 0.0}
 
 

@@ -1,0 +1,95 @@
+"""Fault-tolerance layer (ISSUE 7): bit-exact resume, checkpoint
+integrity + last-good fallback, bounded retries, preemption handling,
+and the deterministic chaos harness that keeps those paths tested.
+
+Three pillars (see the sibling modules):
+
+- ``runstate`` / ``preemption`` — checkpoints capture the *full* run
+  state (device pytree + host-side monitor/telemetry/data-position
+  sidecar), and SIGTERM drains the in-flight step into an emergency
+  checkpoint within a deadline before a clean exit (``EXIT_PREEMPTED``).
+- ``integrity`` / ``retry`` — per-leaf checksums verified on restore,
+  corrupt checkpoints quarantined with automatic fallback to the newest
+  verifiable one (``utils/checkpoint.py``), and transient IO retried
+  with bounded backoff under ``resilience/*`` telemetry counters.
+- ``chaos`` — ``cfg.chaos`` injects SIGTERM / checkpoint corruption /
+  IO errors / NaN batches at configured steps, so the recovery paths
+  above are exercised by the dryrun ``spade_chaos`` leg and
+  ``tests/test_resilience.py``, not just by outages.
+
+``configure(cfg)`` is the single entry point (train.py calls it next to
+``telemetry.configure``): it installs the retry policy and the chaos
+singleton. ``install_preemption_guard(cfg)`` is separate because only
+the training entry point owns signal handlers.
+"""
+
+from imaginaire_tpu.config import cfg_get
+from imaginaire_tpu.resilience import chaos
+from imaginaire_tpu.resilience.integrity import (
+    CheckpointIntegrityError,
+    quarantine_checkpoint,
+    tree_checksums,
+    verify_tree,
+)
+from imaginaire_tpu.resilience.preemption import (
+    EXIT_PREEMPTED,
+    PreemptionGuard,
+    install_preemption_guard,
+)
+from imaginaire_tpu.resilience.retry import (
+    retry_call,
+    retry_settings,
+    set_default_policy,
+)
+from imaginaire_tpu.resilience.runstate import (
+    build_runstate,
+    read_runstate,
+    write_runstate,
+)
+
+__all__ = [
+    "CheckpointIntegrityError",
+    "EXIT_PREEMPTED",
+    "PreemptionGuard",
+    "build_runstate",
+    "chaos",
+    "configure",
+    "install_preemption_guard",
+    "quarantine_checkpoint",
+    "read_runstate",
+    "resilience_settings",
+    "retry_call",
+    "retry_settings",
+    "set_default_policy",
+    "tree_checksums",
+    "verify_tree",
+    "write_runstate",
+]
+
+
+def resilience_settings(cfg):
+    """Parse the ``cfg.resilience`` group (see config.py defaults)."""
+    rcfg = cfg_get(cfg or {}, "resilience", None) or {}
+    enabled = bool(cfg_get(rcfg, "enabled", True))
+    return {
+        "enabled": enabled,
+        "checksum": enabled and bool(cfg_get(rcfg, "checksum", True)),
+        "verify_on_load": enabled and bool(cfg_get(rcfg,
+                                                   "verify_on_load",
+                                                   True)),
+        "emergency_checkpoint": enabled and bool(
+            cfg_get(rcfg, "emergency_checkpoint", True)),
+        "emergency_deadline_s": float(
+            cfg_get(rcfg, "emergency_deadline_s", 60.0) or 0.0),
+        "retry": retry_settings(cfg),
+    }
+
+
+def configure(cfg):
+    """Install the process-wide resilience policy: retry defaults from
+    ``cfg.resilience.retry`` plus the chaos singleton from ``cfg.chaos``.
+    Returns the parsed settings."""
+    settings = resilience_settings(cfg)
+    set_default_policy(settings["retry"])
+    chaos.configure(cfg)
+    return settings

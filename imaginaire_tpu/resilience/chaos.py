@@ -1,0 +1,204 @@
+"""Deterministic fault injection (chaos harness, ISSUE 7).
+
+Recovery paths that only run during real outages are untested product
+code. The ``cfg.chaos`` group injects faults at *configured steps* so
+the dryrun chaos leg and the resilience tests exercise the exact
+machinery production relies on:
+
+- ``sigterm_at_step``            — deliver SIGTERM to this process after
+  that iteration completes (exercises the preemption guard + emergency
+  checkpoint + resume).
+- ``corrupt_checkpoint_at_step`` — flip bytes inside the checkpoint
+  committed at that iteration (exercises integrity verification,
+  quarantine, and last-good fallback on the next resume).
+- ``nan_batch_at_step``          — poison the batch's images with NaN at
+  that iteration (exercises the in-graph non-finite guard + triage).
+- ``io_error_at_step``           — raise a one-shot ``ChaosIOError``
+  from the configured site (``io_error_site``: ``flow_store`` |
+  ``loader``) on that site's Nth access (exercises the bounded-retry
+  wrapper).
+
+Every injection is one-shot per (kind, step) and emits a
+``chaos/<kind>`` telemetry meta event, so a chaos run's jsonl records
+exactly which faults fired where. Disabled (the default) the singleton
+is inert — every ``maybe_*`` is an attribute check and a return.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+
+from imaginaire_tpu.config import cfg_get
+
+logger = logging.getLogger(__name__)
+
+
+class ChaosIOError(IOError):
+    """The injected transient IO failure (retry wrappers recover it)."""
+
+
+def chaos_settings(cfg):
+    ccfg = cfg_get(cfg or {}, "chaos", None) or {}
+
+    def step(key):
+        value = cfg_get(ccfg, key, None)
+        return None if value is None else int(value)
+
+    return {
+        "enabled": bool(cfg_get(ccfg, "enabled", False)),
+        "sigterm_at_step": step("sigterm_at_step"),
+        "corrupt_checkpoint_at_step": step("corrupt_checkpoint_at_step"),
+        "nan_batch_at_step": step("nan_batch_at_step"),
+        "io_error_at_step": step("io_error_at_step"),
+        "io_error_site": str(cfg_get(ccfg, "io_error_site",
+                                     "flow_store")),
+    }
+
+
+def corrupt_checkpoint_bytes(path, n_bytes=64):
+    """Flip ``n_bytes`` in the middle of the largest file under a
+    checkpoint directory (or the file itself) — the byte-corruption
+    primitive the harness injects and the integrity layer must catch.
+    Returns the corrupted file path, or None when nothing was found."""
+    path = str(path)
+    target = path
+    if os.path.isdir(path):
+        largest, size = None, -1
+        for dirpath, _, files in os.walk(path):
+            for name in files:
+                p = os.path.join(dirpath, name)
+                try:
+                    s = os.path.getsize(p)
+                except OSError:
+                    continue
+                if s > size:
+                    largest, size = p, s
+        target = largest
+    if target is None or not os.path.isfile(target):
+        return None
+    size = os.path.getsize(target)
+    if size == 0:
+        return None
+    n = min(int(n_bytes), size)
+    offset = max((size - n) // 2, 0)
+    with open(target, "r+b") as f:
+        f.seek(offset)
+        chunk = f.read(n)
+        f.seek(offset)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    logger.warning("chaos: corrupted %d bytes at offset %d of %s", n,
+                   offset, target)
+    return target
+
+
+class ChaosMonkey:
+    def __init__(self, settings=None):
+        self.settings = settings or chaos_settings({})
+        self.enabled = bool(self.settings["enabled"])
+        self._fired = set()
+        self._site_calls = {}
+
+    # ------------------------------------------------------------ firing
+
+    def _should(self, kind, at_step, step):
+        if not self.enabled or at_step is None or step != at_step:
+            return False
+        token = (kind, int(step))
+        if token in self._fired:
+            return False
+        self._fired.add(token)
+        from imaginaire_tpu import telemetry
+
+        tm = telemetry.get()
+        if tm.enabled:
+            tm.meta(f"chaos/{kind}", step=int(step))
+        logger.warning("chaos: injecting %s at step %s", kind, step)
+        return True
+
+    # ------------------------------------------------------- injection API
+
+    def maybe_sigterm(self, step):
+        """Deliver SIGTERM to this process at the configured step."""
+        if self._should("sigterm", self.settings["sigterm_at_step"],
+                        step):
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_nan_batch(self, data, step):
+        """Return ``data`` with its ``images`` leaf poisoned to NaN at
+        the configured step (shallow copy; other leaves untouched)."""
+        if not self._should("nan_batch",
+                            self.settings["nan_batch_at_step"], step):
+            return data
+        if not isinstance(data, dict) or "images" not in data:
+            return data
+        import jax.numpy as jnp
+
+        images = data["images"]
+        poisoned = type(data)(data)
+        poisoned["images"] = jnp.full(images.shape, jnp.nan,
+                                      dtype=images.dtype)
+        return poisoned
+
+    def maybe_corrupt_checkpoint(self, path, step):
+        """Corrupt the checkpoint committed at the configured step."""
+        if self._should("corrupt_checkpoint",
+                        self.settings["corrupt_checkpoint_at_step"],
+                        step):
+            corrupt_checkpoint_bytes(path)
+
+    def maybe_io_error(self, site):
+        """Raise a one-shot ``ChaosIOError`` on the configured site's
+        Nth access (sites count their own calls — loader/flow-store
+        reads have no global step)."""
+        if not self.enabled or self.settings["io_error_at_step"] is None \
+                or site != self.settings["io_error_site"]:
+            return
+        call = self._site_calls.get(site, 0)
+        self._site_calls[site] = call + 1
+        if call == self.settings["io_error_at_step"] \
+                and self._should(f"io_error/{site}", call, call):
+            raise ChaosIOError(
+                f"chaos-injected transient IO failure at {site} access "
+                f"#{call}")
+
+
+class _NullChaos:
+    """Inert default: every ``maybe_*`` returns immediately."""
+
+    enabled = False
+
+    def maybe_sigterm(self, step):
+        pass
+
+    def maybe_nan_batch(self, data, step):
+        return data
+
+    def maybe_corrupt_checkpoint(self, path, step):
+        pass
+
+    def maybe_io_error(self, site):
+        pass
+
+
+_NULL = _NullChaos()
+_CHAOS = _NULL
+
+
+def get():
+    """The process chaos singleton (inert until ``configure`` opts in)."""
+    return _CHAOS
+
+
+def configure(cfg):
+    """Install the chaos singleton from ``cfg.chaos``; disabled configs
+    install the inert null object."""
+    global _CHAOS
+    settings = chaos_settings(cfg)
+    _CHAOS = ChaosMonkey(settings) if settings["enabled"] else _NULL
+    if settings["enabled"]:
+        logger.warning("chaos harness ENABLED: %s",
+                       {k: v for k, v in settings.items()
+                        if v not in (None, False)})
+    return _CHAOS

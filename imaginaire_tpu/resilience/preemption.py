@@ -1,0 +1,149 @@
+"""SIGTERM/preemption handling: drain the in-flight step, write an
+emergency checkpoint within a deadline, exit cleanly (ISSUE 7).
+
+Preemptible TPU slices deliver SIGTERM with a short grace period before
+SIGKILL. The guard converts that signal into a cooperative flag the
+train loop polls once per iteration: the loop finishes the step it
+already dispatched (orbax blocks on the live arrays, so the save *is*
+the drain), writes a synchronous emergency checkpoint + run-state
+sidecar, shuts the prefetcher producer down, and exits with
+``EXIT_PREEMPTED`` so the supervisor knows the run is resumable rather
+than failed.
+
+The deadline (``cfg.resilience.emergency_deadline_s``) starts at signal
+delivery: if the drain + save has not committed by then, the process
+force-exits (``os._exit``) with the same code — the supervisor's
+SIGKILL was coming anyway, and a forced exit at least leaves the
+previous complete checkpoint and the telemetry trail intact instead of
+dying mid-write *after* the pointer moved.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+
+from imaginaire_tpu.config import cfg_get
+
+logger = logging.getLogger(__name__)
+
+# EX_TEMPFAIL: the conventional "retry me" exit status — distinguishes a
+# preempted-but-checkpointed run from a real failure
+EXIT_PREEMPTED = 75
+
+
+class PreemptionGuard:
+    """Cooperative SIGTERM-to-checkpoint bridge for the train loop."""
+
+    def __init__(self, deadline_s=60.0, signals=(signal.SIGTERM,),
+                 exit_on_deadline=True):
+        self.deadline_s = float(deadline_s or 0.0)
+        self.signals = tuple(signals)
+        self.exit_on_deadline = bool(exit_on_deadline)
+        self._triggered = threading.Event()
+        self._timer = None
+        self._prev_handlers = {}
+        self.signum = None
+
+    # ------------------------------------------------------------ install
+
+    def install(self):
+        """Register the handlers (main thread only — signal.signal
+        raises elsewhere, in which case the guard stays inert)."""
+        try:
+            for sig in self.signals:
+                self._prev_handlers[sig] = signal.signal(sig,
+                                                         self._handler)
+        except ValueError:
+            logger.warning(
+                "preemption guard not installed (not the main thread); "
+                "SIGTERM will kill the run without an emergency "
+                "checkpoint")
+            self._prev_handlers = {}
+        return self
+
+    def uninstall(self):
+        self.disarm()
+        for sig, prev in self._prev_handlers.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):
+                pass
+        self._prev_handlers = {}
+
+    # ------------------------------------------------------------ handler
+
+    def _handler(self, signum, frame):
+        first = not self._triggered.is_set()
+        self._triggered.set()
+        self.signum = signum
+        if not first:
+            return  # repeated signals: the drain is already running
+        from imaginaire_tpu import telemetry
+
+        tm = telemetry.get()
+        if tm.enabled:
+            tm.meta("resilience/preempt_signal", signum=int(signum),
+                    deadline_s=self.deadline_s)
+            tm.counter("resilience/preemptions", 1)
+        logger.warning(
+            "signal %d received: draining the in-flight step and "
+            "writing an emergency checkpoint (deadline %.1fs)",
+            signum, self.deadline_s)
+        if self.deadline_s > 0:
+            self._timer = threading.Timer(self.deadline_s,
+                                          self._deadline_expired)
+            self._timer.daemon = True
+            self._timer.start()
+
+    @property
+    def triggered(self):
+        return self._triggered.is_set()
+
+    def disarm(self):
+        """Cancel the deadline timer — call once the emergency
+        checkpoint has committed."""
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    # ----------------------------------------------------------- deadline
+
+    def _deadline_expired(self):
+        from imaginaire_tpu import telemetry
+
+        logger.error(
+            "emergency-checkpoint deadline (%.1fs) expired before the "
+            "drain finished; force-exiting — the pointer still names "
+            "the previous complete checkpoint", self.deadline_s)
+        tm = telemetry.get()
+        try:
+            if tm.enabled:
+                tm.meta("resilience/preempt_deadline_expired",
+                        deadline_s=self.deadline_s)
+                tm.flush()
+        except Exception:  # noqa: BLE001 — exiting either way
+            pass
+        if self.exit_on_deadline:
+            os._exit(EXIT_PREEMPTED)
+
+
+def preemption_settings(cfg):
+    rcfg = cfg_get(cfg or {}, "resilience", None) or {}
+    return {
+        "enabled": bool(cfg_get(rcfg, "emergency_checkpoint", True))
+        and bool(cfg_get(rcfg, "enabled", True)),
+        "deadline_s": float(cfg_get(rcfg, "emergency_deadline_s", 60.0)
+                            or 0.0),
+    }
+
+
+def install_preemption_guard(cfg):
+    """Build + install a guard from ``cfg.resilience``; None when the
+    emergency-checkpoint machinery is disabled."""
+    s = preemption_settings(cfg)
+    if not s["enabled"]:
+        return None
+    return PreemptionGuard(deadline_s=s["deadline_s"]).install()

@@ -1,0 +1,94 @@
+"""Bounded retry-with-backoff for transient IO (ISSUE 7).
+
+A preemptible-slice run's checkpoint commits and flow-cache shard IO
+cross network filesystems that throw transient ``OSError``s under load;
+one flaky write must not kill a multi-hour run. ``retry_call`` retries a
+callable a bounded number of times with exponential backoff, counting
+every retry into the ``resilience/retry/<label>`` telemetry counter and
+emitting a ``resilience/retry_exhausted`` meta event before the final
+exception propagates — so retried IO is *visible*, never silent.
+
+The default policy comes from ``cfg.resilience.retry`` via
+``resilience.configure`` (train.py calls it); library call sites that
+predate configuration fall back to the module defaults below.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from imaginaire_tpu.config import cfg_get
+
+logger = logging.getLogger(__name__)
+
+# module defaults; resilience.configure overlays cfg.resilience.retry
+_POLICY = {
+    "retries": 3,       # total attempts = retries (1 first try + retries-1)
+    "backoff_s": 0.1,   # first sleep; doubles per attempt
+    "max_backoff_s": 2.0,
+}
+
+
+def retry_settings(cfg):
+    """Parse ``cfg.resilience.retry`` over the module defaults."""
+    rcfg = cfg_get(cfg_get(cfg or {}, "resilience", {}) or {}, "retry",
+                   None) or {}
+    return {
+        "retries": max(int(cfg_get(rcfg, "retries", _POLICY["retries"])), 1),
+        "backoff_s": float(cfg_get(rcfg, "backoff_s",
+                                   _POLICY["backoff_s"])),
+        "max_backoff_s": float(cfg_get(rcfg, "max_backoff_s",
+                                       _POLICY["max_backoff_s"])),
+    }
+
+
+def set_default_policy(policy):
+    """Install the process-wide retry policy (``resilience.configure``)."""
+    _POLICY.update({k: policy[k] for k in ("retries", "backoff_s",
+                                           "max_backoff_s") if k in policy})
+
+
+def retry_call(fn, *args, label="io", retries=None, backoff_s=None,
+               max_backoff_s=None, retry_on=(OSError,), _sleep=time.sleep,
+               **kwargs):
+    """Call ``fn(*args, **kwargs)``; retry on ``retry_on`` exceptions.
+
+    Retries ``retries`` total attempts with exponential backoff
+    (``backoff_s * 2^attempt``, capped at ``max_backoff_s``). Each retry
+    bumps ``resilience/retry/<label>``; exhausting the budget emits a
+    ``resilience/retry_exhausted`` meta event and re-raises the last
+    exception. Exceptions outside ``retry_on`` propagate immediately
+    (corruption is not transient — the caller quarantines instead).
+    """
+    from imaginaire_tpu import telemetry
+
+    attempts = max(int(retries if retries is not None
+                       else _POLICY["retries"]), 1)
+    base = float(backoff_s if backoff_s is not None
+                 else _POLICY["backoff_s"])
+    cap = float(max_backoff_s if max_backoff_s is not None
+                else _POLICY["max_backoff_s"])
+    last = None
+    for attempt in range(attempts):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            last = e
+            if attempt + 1 >= attempts:
+                break
+            delay = min(base * (2 ** attempt), cap)
+            tm = telemetry.get()
+            if tm.enabled:
+                tm.counter(f"resilience/retry/{label}", attempt + 1)
+            logger.warning(
+                "transient %s failure (attempt %d/%d), retrying in "
+                "%.2fs: %s", label, attempt + 1, attempts, delay, e)
+            _sleep(delay)
+    tm = telemetry.get()
+    if tm.enabled:
+        tm.meta("resilience/retry_exhausted", label=label,
+                attempts=attempts, error=str(last))
+    logger.error("%s failed after %d attempt(s): %s", label, attempts,
+                 last)
+    raise last

@@ -1,0 +1,83 @@
+"""Run-state sidecar: the host-side training state a bit-exact resume
+needs beyond the device pytree (ISSUE 7).
+
+The checkpoint's device state already carries the RNG key chain and the
+step counters; what the pointer-file restart used to *silently reset*
+was everything host-side: the mid-epoch data position (the epoch's
+shuffle is seeded, but the batches-consumed offset was lost — a resumed
+run replayed the epoch from batch 0), the HealthMonitor's EWMA/breach
+history, and the telemetry ring. ``<ckpt>.runstate.json`` captures them
+at save time; ``BaseTrainer.load_checkpoint`` replays them on resume
+and the train loop fast-forwards the loader by ``batch_in_epoch``.
+
+JSON (not orbax) on purpose: the payload is a few KB of host floats,
+must stay readable when the array data is corrupt (the fallback scan
+reads candidates' run state), and a schema change must never invalidate
+the array tree.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+RUNSTATE_VERSION = 1
+_SUFFIX = ".runstate.json"
+
+
+def runstate_path(checkpoint_path):
+    return str(checkpoint_path) + _SUFFIX
+
+
+def build_runstate(epoch, iteration, batch_in_epoch, monitor=None,
+                   telemetry_state=None):
+    return {
+        "version": RUNSTATE_VERSION,
+        "epoch": int(epoch),
+        "iteration": int(iteration),
+        "batch_in_epoch": int(max(batch_in_epoch, 0)),
+        "monitor": monitor or {},
+        "telemetry": telemetry_state or {},
+    }
+
+
+def write_runstate(checkpoint_path, runstate):
+    """Master-only sidecar write; failures degrade to a warning (a
+    missing runstate means a coarse resume, never a failed save)."""
+    from imaginaire_tpu.parallel.mesh import is_master
+
+    if not is_master():
+        return None
+    path = runstate_path(checkpoint_path)
+    try:
+        from imaginaire_tpu.resilience.retry import retry_call
+
+        def _write():
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(runstate, f, indent=1, default=str)
+            os.replace(tmp, path)
+
+        retry_call(_write, label="runstate_write")
+        return path
+    except Exception as e:  # noqa: BLE001 — never fail a save over this
+        logger.warning("runstate sidecar write failed for %s: %s",
+                       checkpoint_path, e)
+        return None
+
+
+def read_runstate(checkpoint_path):
+    """The saved run state, or None (legacy checkpoint / unreadable)."""
+    path = runstate_path(checkpoint_path)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        logger.warning("unreadable runstate sidecar %s: %s (resuming "
+                       "with a coarse epoch restart)", path, e)
+        return None

@@ -472,6 +472,35 @@ class Telemetry:
                 summary["imgs_per_sec"] = round(items / elapsed, 3)
         return summary
 
+    # -------------------------------------------------------- run state
+
+    def state_dict(self):
+        """JSON-serializable telemetry accounting for the checkpoint's
+        runstate sidecar (resilience/, ISSUE 7): step-time ring + EWMA
+        + last step, so a resumed run's p50/p99 and EWMA counters
+        continue the killed run's series instead of re-warming from
+        empty. Window totals are deliberately NOT captured — a resume
+        starts a fresh throughput window (wall-clock across processes
+        is meaningless)."""
+        if not self.enabled:
+            return {}
+        with self._lock:
+            return {"ring": [float(x) for x in self._ring],
+                    "ewma": self._ewma,
+                    "last_step": self.last_step}
+
+    def load_state_dict(self, state):
+        if not self.enabled or not state:
+            return
+        with self._lock:
+            ring = state.get("ring") or []
+            self._ring.clear()
+            self._ring.extend(float(x) for x in ring)
+            if state.get("ewma") is not None:
+                self._ewma = float(state["ewma"])
+            if state.get("last_step") is not None:
+                self.last_step = state["last_step"]
+
     def reset_window(self):
         """Zero every accumulator (bench legs A/B the same process)."""
         with self._lock:

@@ -47,6 +47,14 @@ def summarize(events):
     nonfinite_events = []
     recompile_events = []
     oom_events = []
+    fallback_events = []
+    quarantine_events = []
+    resume_events = []
+    divergence_events = []
+    preempt_events = []
+    chaos_events = []
+    gc_events = []
+    retry_exhausted = []
     meta = {}
     hangs = []
     t_min = t_max = None
@@ -74,12 +82,30 @@ def summarize(events):
                 flow_cache_series.setdefault(ev["name"], []).append(
                     float(ev.get("value") or 0.0))
         elif kind == "meta":
-            if ev.get("name") == "nonfinite":
+            name = ev.get("name")
+            if name == "nonfinite":
                 nonfinite_events.append(ev)
-            elif ev.get("name") == "xla_recompile":
+            elif name == "xla_recompile":
                 recompile_events.append(ev)
-            elif ev.get("name") == "oom":
+            elif name == "oom":
                 oom_events.append(ev)
+            elif name == "ckpt/fallback":
+                fallback_events.append(ev)
+            elif name == "ckpt/quarantined":
+                quarantine_events.append(ev)
+            elif name == "ckpt/gc":
+                gc_events.append(ev)
+            elif name == "resilience/resume":
+                resume_events.append(ev)
+            elif name == "resilience/resume_divergence":
+                divergence_events.append(ev)
+            elif name in ("resilience/preempt_signal",
+                          "resilience/preempt_deadline_expired"):
+                preempt_events.append(ev)
+            elif name == "resilience/retry_exhausted":
+                retry_exhausted.append(ev)
+            elif str(name).startswith("chaos/"):
+                chaos_events.append(ev)
             meta[ev.get("name", "?")] = ev
         elif kind == "hang":
             hangs.append(ev)
@@ -150,9 +176,42 @@ def summarize(events):
         "mem_peak_frac": mem_peak_frac,
         "oom_events": oom_events,
     }
+    # fault-tolerance accounting (ISSUE 7): fallbacks/quarantines are
+    # gated by check_run_health --max-fallbacks; any resume-divergence
+    # event fails the gate outright. Counters are cumulative, so the
+    # latest value is the run total.
+    retries = sum(int(v or 0) for name, (v, _) in counters.items()
+                  if str(name).startswith("resilience/retry/"))
+    resilience = {
+        "present": bool(fallback_events or quarantine_events
+                        or resume_events or preempt_events
+                        or chaos_events or retries
+                        or any(str(n).startswith("resilience/")
+                               for n in counters)),
+        "fallbacks": int(counters.get("resilience/ckpt_fallbacks",
+                                      (0, None))[0] or 0)
+        or len(fallback_events),
+        "quarantined": len(quarantine_events),
+        "retries": retries,
+        "retry_exhausted": retry_exhausted,
+        "preemptions": int(counters.get("resilience/preemptions",
+                                        (0, None))[0] or 0),
+        "emergency_ckpt_ms": counters.get("resilience/emergency_ckpt_ms",
+                                          (None, None))[0],
+        "corrupt_flow_shards": int(
+            counters.get("flow_cache/corrupt_shards", (0, None))[0] or 0),
+        "gc_deleted": int(counters.get("resilience/ckpt_gc_deleted",
+                                       (0, None))[0] or 0),
+        "resume_events": resume_events,
+        "divergence_events": divergence_events,
+        "fallback_events": fallback_events,
+        "chaos_events": chaos_events,
+        "gc_events": gc_events,
+    }
     return {"phases": table, "counters": counters, "meta": meta,
             "hangs": hangs, "wall_s": wall_s, "health": health,
-            "flow_cache": flow_cache, "xla": xla}
+            "flow_cache": flow_cache, "xla": xla,
+            "resilience": resilience}
 
 
 def _trend(series):
@@ -261,6 +320,49 @@ def _xla_section(s):
     return lines
 
 
+def _resilience_section(s):
+    """Markdown lines for the fault-tolerance section. Empty when the
+    run carried no resilience events (the common, healthy case)."""
+    r = s.get("resilience") or {}
+    if not r.get("present"):
+        return []
+    lines = ["", "## resilience"]
+    if r.get("preemptions"):
+        ms = r.get("emergency_ckpt_ms")
+        lines.append(f"- preemptions: {r['preemptions']}"
+                     + (f" (emergency checkpoint {ms:.0f}ms)"
+                        if ms is not None else ""))
+    if r.get("fallbacks") or r.get("quarantined"):
+        lines.append(f"!! checkpoint fallbacks: {r.get('fallbacks', 0)} "
+                     f"(quarantined: {r.get('quarantined', 0)})")
+        for ev in r.get("fallback_events", []):
+            lines.append(f"  - skipped {ev.get('skipped')}: "
+                         f"{str(ev.get('error'))[:120]}")
+    for ev in r.get("divergence_events", []):
+        lines.append(
+            f"!! resume divergence: checkpoint iter "
+            f"{ev.get('checkpoint_iteration')} vs runstate "
+            f"{ev.get('runstate_iteration')} ({ev.get('checkpoint')})")
+    for ev in r.get("resume_events", []):
+        lines.append(f"- resumed from {ev.get('checkpoint')} at iter "
+                     f"{ev.get('iteration')} "
+                     f"(runstate: {ev.get('runstate')}, batch offset "
+                     f"{ev.get('batch_in_epoch', 0)})")
+    if r.get("retries"):
+        lines.append(f"- transient-IO retries: {r['retries']}"
+                     + (f" (!! {len(r['retry_exhausted'])} exhausted)"
+                        if r.get("retry_exhausted") else ""))
+    if r.get("corrupt_flow_shards"):
+        lines.append(f"- corrupt flow-cache shards quarantined: "
+                     f"{r['corrupt_flow_shards']}")
+    if r.get("gc_deleted"):
+        lines.append(f"- checkpoint GC deleted: {r['gc_deleted']}")
+    for ev in r.get("chaos_events", []):
+        lines.append(f"- chaos injected: {ev.get('name')} at step "
+                     f"{ev.get('step')}")
+    return lines
+
+
 def render_report(path_or_events):
     """Markdown-ish report (the PROFILE.md table format) for a
     telemetry.jsonl path or a pre-loaded event list."""
@@ -305,6 +407,7 @@ def render_report(path_or_events):
                      f"{flops_meta.get('peak_source')})")
     lines.extend(_health_section(s))
     lines.extend(_xla_section(s))
+    lines.extend(_resilience_section(s))
     if s["hangs"]:
         lines.append("")
         lines.append(f"!! {len(s['hangs'])} hang dump(s) recorded:")

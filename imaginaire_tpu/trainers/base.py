@@ -101,6 +101,13 @@ class BaseTrainer:
 
         self.current_epoch = 0
         self.current_iteration = 0
+        # bit-exact resume bookkeeping (resilience/, ISSUE 7): the
+        # epoch-relative batches-consumed offset rides the checkpoint's
+        # runstate sidecar; on resume the train loop fast-forwards the
+        # loader by ``resume_batch_in_epoch`` instead of replaying the
+        # epoch from batch 0.
+        self._epoch_start_iteration = 0
+        self.resume_batch_in_epoch = 0
         self.state: Optional[dict] = None
         self.meters: Dict[str, Meter] = {}
         self.time_iteration = None
@@ -428,6 +435,14 @@ class BaseTrainer:
         self._start_of_epoch(current_epoch)
         self.current_epoch = current_epoch
         self.start_epoch_time = time.time()
+        # epoch-relative batch accounting: normally this epoch starts at
+        # the current iteration; on the first epoch after a mid-epoch
+        # resume, ``resume_batch_in_epoch`` batches were already
+        # consumed before the kill (the train loop fast-forwards the
+        # loader past them), so the epoch's true start lies behind us.
+        offset = int(self.resume_batch_in_epoch or 0)
+        self._epoch_start_iteration = self.current_iteration - offset
+        self.resume_batch_in_epoch = 0
 
     def start_of_iteration(self, data, current_iteration):
         from imaginaire_tpu.data.device_prefetch import PrefetchedBatch
@@ -772,23 +787,44 @@ class BaseTrainer:
         e.g. pix2pixHD computes K-means cluster centers here)."""
         pass
 
-    def save_checkpoint(self, current_epoch, current_iteration):
-        """(ref: base.py:790-829)."""
+    def save_checkpoint(self, current_epoch, current_iteration,
+                        emergency=False):
+        """(ref: base.py:790-829).
+
+        ``emergency``: the preemption-guard path — forces a synchronous
+        commit (the process is about to exit; an async save would race
+        the teardown) and stamps the run state so resume is bit-exact.
+        """
+        from imaginaire_tpu import resilience
+
         self._pre_save_checkpoint()
         logdir = cfg_get(self.cfg, "logdir", ".")
+        rset = resilience.resilience_settings(self.cfg)
         meta = {"epoch": current_epoch, "iteration": current_iteration}
         path = ckpt_lib.save_checkpoint(
             logdir, {"state": self.state, "meta": meta},
             current_epoch, current_iteration,
-            async_save=bool(cfg_get(self.cfg.trainer, "async_checkpoint",
-                                    False)))
-        # Partition descriptor sidecar: restore compares it against the
-        # live plan and reshards (jax.device_put) on any mesh-shape /
-        # sharding-policy change instead of crashing or silently
-        # replicating (see load_checkpoint).
-        if self.partition.active:
-            ckpt_lib.write_partition_sidecar(path,
-                                             self.partition.describe())
+            max_to_keep=cfg_get(self.cfg, "checkpoints_to_keep", None),
+            async_save=(not emergency
+                        and bool(cfg_get(self.cfg.trainer,
+                                         "async_checkpoint", False))),
+            # Partition descriptor sidecar: restore compares it against
+            # the live plan and reshards (jax.device_put) on any
+            # mesh-shape / sharding-policy change instead of crashing or
+            # silently replicating (see load_checkpoint). ISSUE 7: the
+            # per-leaf checksums ride the same sidecar.
+            partition_descriptor=(self.partition.describe()
+                                  if self.partition.active else None),
+            checksum=rset["checksum"])
+        # Run-state sidecar (resilience/runstate.py): the host-side half
+        # of a bit-exact resume — mid-epoch data position plus the
+        # HealthMonitor and telemetry-ring state the pointer-file
+        # restart used to silently reset.
+        resilience.write_runstate(path, resilience.build_runstate(
+            current_epoch, current_iteration,
+            current_iteration - self._epoch_start_iteration,
+            monitor=self.diag.state_dict(),
+            telemetry_state=telemetry.get().state_dict()))
         # Recalibrated EMA BN stats ride alongside (a sibling file keeps
         # the state tree's structure stable across checkpoint versions);
         # the reference persists them inside the averaged model's buffers.
@@ -803,25 +839,44 @@ class BaseTrainer:
 
     def load_checkpoint(self, checkpoint_path=None, resume=None):
         """(ref: base.py:210-265): explicit path = weights-only unless
-        resume=True; pointer-file discovery = resume."""
+        resume=True; pointer-file discovery = resume.
+
+        The discovery path verifies checksums and falls back: a corrupt
+        / truncated pointed checkpoint is quarantined and the newest
+        verifiable one restores instead (``ckpt_lib.load_latest_verified``).
+        An explicit path never falls back — the caller asked for that
+        exact checkpoint, so corruption raises."""
+        from imaginaire_tpu import resilience
+
         logdir = cfg_get(self.cfg, "logdir", ".")
+        verify = resilience.resilience_settings(self.cfg)["verify_on_load"]
+        target = ({"state": self.state,
+                   "meta": {"epoch": 0, "iteration": 0}}
+                  if self.state is not None else None)
         # an in-flight async save must commit before we read anything back
         ckpt_lib.wait_for_pending_checkpoint()
         if checkpoint_path is None:
-            checkpoint_path = ckpt_lib.latest_checkpoint_path(logdir)
-            if checkpoint_path is None:
+            payload, checkpoint_path, fallbacks = \
+                ckpt_lib.load_latest_verified(logdir, target=target,
+                                              verify=verify)
+            if payload is None:
                 print("No checkpoint found.")
                 return False
+            if fallbacks:
+                print(f"Checkpoint fallback: restored {checkpoint_path} "
+                      f"after quarantining {fallbacks} corrupt "
+                      f"checkpoint(s)")
             resume = True if resume is None else resume
-        payload = ckpt_lib.load_checkpoint(
-            checkpoint_path,
-            target={"state": self.state, "meta": {"epoch": 0, "iteration": 0}}
-            if self.state is not None else None)
+        else:
+            payload = ckpt_lib.load_checkpoint(checkpoint_path,
+                                               target=target,
+                                               verify=verify)
         restored = payload["state"]
         if resume:
             self.state = restored
             self.current_epoch = int(payload["meta"]["epoch"])
             self.current_iteration = int(payload["meta"]["iteration"])
+            self._restore_runstate(checkpoint_path)
         elif self.state is None:
             # weights-only load before init_state: adopt the restored
             # state wholesale (counters stay at 0).
@@ -842,6 +897,94 @@ class BaseTrainer:
                 self._ema_batch_stats = pickle.load(f)
         print(f"Done with loading the checkpoint (resume={bool(resume)}).")
         return True
+
+    def _restore_runstate(self, checkpoint_path):
+        """Replay the checkpoint's host-side run state (runstate
+        sidecar): mid-epoch data position, HealthMonitor history, and
+        the telemetry ring. A sidecar whose counters disagree with the
+        checkpoint's own meta emits a ``resilience/resume_divergence``
+        meta event — ``check_run_health`` fails any run that carries
+        one (a stale or cross-wired sidecar would desynchronize the
+        data stream from the RNG/step state)."""
+        from imaginaire_tpu import resilience
+
+        runstate = resilience.read_runstate(checkpoint_path)
+        tm = telemetry.get()
+        if runstate is None:
+            # legacy checkpoint: coarse resume (epoch restarts at batch
+            # 0, monitor/telemetry state fresh) — still correct weights,
+            # just not bit-exact against an uninterrupted run
+            self.resume_batch_in_epoch = 0
+            if tm.enabled:
+                tm.meta("resilience/resume", checkpoint=str(checkpoint_path),
+                        iteration=self.current_iteration,
+                        runstate=False)
+            return
+        if (int(runstate.get("iteration", -1)) != self.current_iteration
+                or int(runstate.get("epoch", -1)) != self.current_epoch):
+            if tm.enabled:
+                tm.meta("resilience/resume_divergence",
+                        checkpoint=str(checkpoint_path),
+                        checkpoint_iteration=self.current_iteration,
+                        runstate_iteration=runstate.get("iteration"),
+                        checkpoint_epoch=self.current_epoch,
+                        runstate_epoch=runstate.get("epoch"))
+            import logging
+
+            logging.getLogger(__name__).error(
+                "runstate sidecar disagrees with checkpoint meta "
+                "(ckpt epoch/iter %s/%s vs runstate %s/%s); ignoring "
+                "the sidecar — resume will be coarse, not bit-exact",
+                self.current_epoch, self.current_iteration,
+                runstate.get("epoch"), runstate.get("iteration"))
+            self.resume_batch_in_epoch = 0
+            return
+        self.resume_batch_in_epoch = int(runstate.get("batch_in_epoch",
+                                                      0) or 0)
+        try:
+            self.diag.load_state_dict(runstate.get("monitor") or {})
+        except Exception as e:  # noqa: BLE001 — observability only
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "health-monitor state restore failed: %s", e)
+        try:
+            tm.load_state_dict(runstate.get("telemetry") or {})
+        except Exception as e:  # noqa: BLE001
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "telemetry state restore failed: %s", e)
+        if tm.enabled:
+            tm.meta("resilience/resume", checkpoint=str(checkpoint_path),
+                    iteration=self.current_iteration,
+                    batch_in_epoch=self.resume_batch_in_epoch,
+                    runstate=True)
+
+    def emergency_checkpoint(self, current_epoch, current_iteration,
+                             guard=None):
+        """Preemption drain: synchronous checkpoint + run-state sidecar
+        under the ``ckpt_emergency`` span; disarms the guard's deadline
+        timer once the commit lands. Returns the checkpoint path."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with telemetry.span("ckpt_emergency", step=current_iteration):
+            path = self.save_checkpoint(current_epoch, current_iteration,
+                                        emergency=True)
+        ckpt_lib.wait_for_pending_checkpoint()
+        dur_ms = (_time.perf_counter() - t0) * 1e3
+        tm = telemetry.get()
+        if tm.enabled:
+            tm.counter("resilience/emergency_ckpt_ms", dur_ms,
+                       step=current_iteration)
+            tm.meta("resilience/emergency_checkpoint", path=str(path),
+                    iteration=current_iteration, dur_ms=round(dur_ms, 2))
+        if guard is not None:
+            guard.disarm()
+        print(f"Emergency checkpoint committed in {dur_ms:.0f}ms -> "
+              f"{path}")
+        return path
 
     def _reshard_restored_state(self, checkpoint_path):
         """Re-place a restored state under the CURRENT partition plan.
@@ -867,6 +1010,15 @@ class BaseTrainer:
                   f"{saved} -> current {current}")
         if self.partition.active:
             self.state = self._place_state(self.state)
+        else:
+            # the restored leaves are host numpy (load_checkpoint is
+            # layout-agnostic by design); commit them to device arrays
+            # jax owns before the first post-restore step — the step
+            # programs donate their state argument, and donation
+            # semantics for borrowed numpy buffers are the backend's
+            # call, not a contract. One explicit transfer here keeps
+            # resume on the same committed-state footing as init_state.
+            self.state = jax.device_put(self.state)
 
     # ------------------------------------------------------------ inference
 

@@ -23,6 +23,25 @@ immediately (preemption-safe: an interrupted async save leaves only a
 tmp dir, never a half-committed checkpoint — the pointer still names the
 previous complete one). Call ``wait_for_pending_checkpoint()`` before
 reading the checkpoint back or exiting the process.
+
+Fault tolerance (ISSUE 7, ``resilience/``):
+  - per-leaf crc32 checksums are computed at save time and ride the
+    checkpoint's sidecar — the existing ``.partition.json`` when a
+    partition descriptor is saved, ``.integrity.json`` otherwise;
+  - ``load_checkpoint`` verifies the restored bytes against them and
+    raises ``CheckpointIntegrityError`` on mismatch;
+  - ``load_latest_verified`` implements the resume path: a corrupt /
+    truncated / missing pointed checkpoint is quarantined (``*.corrupt``
+    rename + ``ckpt/quarantined`` meta event) and the newest checkpoint
+    that DOES verify is restored instead (``ckpt/fallback`` +
+    ``resilience/ckpt_fallbacks``);
+  - ``latest_checkpoint_path`` falls back to a logdir scan when the
+    pointer names a dead path (a crash between quarantine/deletion and
+    the next pointer write must not strand the run);
+  - ``max_to_keep`` retention GC runs after each pointer write and never
+    deletes the pointer target or the newest verifiable checkpoint;
+  - pointer/sidecar writes retry transient IO with bounded backoff
+    (``resilience/retry.py``).
 """
 
 from __future__ import annotations
@@ -36,6 +55,7 @@ from imaginaire_tpu import telemetry
 from imaginaire_tpu.parallel.mesh import is_master
 
 _POINTER = "latest_checkpoint.txt"
+_CKPT_RE = re.compile(r"^epoch_(\d+)_iteration_(\d+)_checkpoint$")
 
 # Lazily-built singleton: AsyncCheckpointer owns a thread pool + barrier
 # state, so one per process, reused across saves.
@@ -64,8 +84,28 @@ def parse_checkpoint_name(name):
     return int(m.group(1)), int(m.group(2))
 
 
+def scan_checkpoints(logdir):
+    """Committed checkpoints under ``logdir``, oldest first, as
+    ``[(epoch, iteration, path), ...]``. Only exact
+    ``epoch_*_iteration_*_checkpoint`` directory names count —
+    quarantined ``*.corrupt`` renames and tmp dirs never match."""
+    try:
+        names = os.listdir(logdir)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _CKPT_RE.match(name)
+        path = os.path.join(logdir, name)
+        if m and os.path.isdir(path):
+            out.append((int(m.group(1)), int(m.group(2)), path))
+    out.sort()
+    return out
+
+
 def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None,
-                    async_save=False):
+                    async_save=False, partition_descriptor=None,
+                    checksum=True):
     """Collective save of the sharded state + master-only pointer write.
 
     Every process passes its live state pytree; orbax writes each array
@@ -73,7 +113,19 @@ def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None,
     With ``async_save`` the call returns as soon as device arrays are
     snapshotted; the pointer is then written by a completion callback so
     it never names an uncommitted checkpoint.
+
+    ``checksum`` computes per-leaf crc32 checksums of the state at
+    dispatch time (one device_get of the addressable leaves — see
+    PROFILE.md for the cost) and writes them into the checkpoint's
+    sidecar after the commit; ``partition_descriptor`` (the active
+    partition plan's ``describe()``) makes that sidecar the existing
+    ``.partition.json``, otherwise checksums land in
+    ``.integrity.json``. ``max_to_keep`` enables retention GC after the
+    pointer write (never deletes the pointer target or the newest
+    verifiable checkpoint).
     """
+    from imaginaire_tpu.resilience import chaos
+
     name = checkpoint_name(epoch, iteration)
     path = os.path.abspath(os.path.join(logdir, name))
     # commit any in-flight async save first: back-to-back saves would
@@ -83,8 +135,38 @@ def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None,
 
     def _write_pointer():
         if is_master():
-            with open(os.path.join(logdir, _POINTER), "w") as f:
-                f.write(name + "\n")
+            from imaginaire_tpu.resilience.retry import retry_call
+
+            def _write():
+                with open(os.path.join(logdir, _POINTER), "w") as f:
+                    f.write(name + "\n")
+
+            retry_call(_write, label="ckpt_pointer")
+
+    def _after_commit():
+        """Sidecar + pointer + GC + chaos hook — runs strictly after
+        the array data is committed, in commit order. The committed
+        files' raw-byte digests join the integrity record here (they
+        only exist post-commit): restore verifies THEM before the
+        deserializer touches the data — feeding corrupt bytes to a
+        native decoder is a heap hazard, not just a wrong answer."""
+        full = integrity
+        if full is not None:
+            try:
+                from imaginaire_tpu.resilience.integrity import (
+                    file_digests,
+                )
+
+                full = dict(full, files=file_digests(path))
+            except Exception as e:  # noqa: BLE001 — never fail a save
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "checkpoint file-digest pass failed: %s", e)
+        _write_sidecars(path, partition_descriptor, full)
+        _write_pointer()
+        gc_checkpoints(logdir, max_to_keep, protect=(path,))
+        chaos.get().maybe_corrupt_checkpoint(path, iteration)
 
     if os.path.exists(path):
         # idempotent per (epoch, iteration): the final-iteration save and
@@ -96,6 +178,22 @@ def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None,
         print(f"Checkpoint {name} already exists; skipping duplicate save")
         _write_pointer()
         return path
+
+    # checksums are computed from the live arrays BEFORE dispatch: after
+    # an async save returns, the caller's buffers may be donated to the
+    # next step, so the commit thread must never touch ``state`` again
+    integrity = None
+    if checksum and is_master():
+        from imaginaire_tpu.resilience.integrity import tree_checksums
+
+        with telemetry.span("ckpt_checksum"):
+            try:
+                integrity = tree_checksums(state)
+            except Exception as e:  # noqa: BLE001 — never fail a save
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "checkpoint checksum computation failed: %s", e)
 
     if async_save:
         global _POINTER_THREAD
@@ -121,7 +219,7 @@ def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None,
             try:
                 with telemetry.span("ckpt_commit"):
                     ckpt.wait_until_finished()
-                _write_pointer()
+                _after_commit()
             except BaseException as e:  # re-raised by the joiner
                 _commit_then_point.error = e
 
@@ -135,7 +233,7 @@ def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None,
         with telemetry.span("ckpt"):
             with ocp.PyTreeCheckpointer() as ckpt:
                 ckpt.save(path, state)
-        _write_pointer()
+        _after_commit()
         telemetry.get().heartbeat()
     return path
 
@@ -161,30 +259,78 @@ def wait_for_pending_checkpoint():
 
 
 def latest_checkpoint_path(logdir):
-    """(ref: base.py:225-233)."""
+    """The pointed checkpoint (ref: base.py:225-233) — falling back to
+    the newest parseable checkpoint in ``logdir`` when the pointer names
+    a missing/unreadable path (quarantined, GC'd by an older policy, or
+    torn by a crash). No pointer file at all still returns None: only
+    the master ever writes it, and a fresh logdir must not resume from
+    stray directories."""
     pointer = os.path.join(logdir, _POINTER)
     if not os.path.exists(pointer):
         return None
-    with open(pointer) as f:
-        name = f.read().strip()
-    path = os.path.join(logdir, name)
-    return path if os.path.exists(path) else None
+    try:
+        with open(pointer) as f:
+            name = f.read().strip()
+    except OSError:
+        name = ""
+    path = os.path.join(logdir, name) if name else None
+    if path and os.path.exists(path):
+        return path
+    entries = scan_checkpoints(logdir)
+    if not entries:
+        return None
+    fallback = entries[-1][2]
+    telemetry.get().meta("ckpt/pointer_fallback", pointer=name or None,
+                         fallback=fallback)
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "latest_checkpoint.txt names %r which does not exist; falling "
+        "back to newest checkpoint in logdir: %s", name, fallback)
+    return fallback
 
 
-def write_partition_sidecar(path, descriptor):
+# ------------------------------------------------------------- sidecars
+
+
+def _write_sidecars(path, partition_descriptor, integrity):
+    """Write the checkpoint's sidecar(s): checksums ride the partition
+    sidecar when a descriptor is saved, ``.integrity.json`` otherwise
+    (replicated checkpoints carry no ``.partition.json`` — legacy
+    readers treat its absence as 'saved replicated')."""
+    if partition_descriptor is not None:
+        write_partition_sidecar(path, partition_descriptor,
+                                integrity=integrity)
+    elif integrity is not None:
+        write_integrity_sidecar(path, integrity)
+
+
+def write_partition_sidecar(path, descriptor, integrity=None):
     """Persist the saving run's partition-plan descriptor (mesh axes/
     shape + update-state sharding knobs, see
     ``PartitionPlan.describe``) as a ``<ckpt>.partition.json`` sibling —
     like the ``.ema_bn.pkl`` sibling, a sidecar keeps the state tree's
     structure stable across checkpoint versions. Master-only; a missing
-    sidecar means 'saved replicated' (pre-ISSUE-6 checkpoints)."""
+    sidecar means 'saved replicated' (pre-ISSUE-6 checkpoints). The
+    per-leaf ``integrity`` checksums ride the same file under the
+    reserved ``integrity`` key (``read_partition_sidecar`` strips it)."""
     import json
 
     if not is_master():
         return
+    payload = dict(descriptor or {})
+    if integrity is not None:
+        payload["integrity"] = integrity
     try:
-        with open(str(path) + ".partition.json", "w") as f:
-            json.dump(descriptor, f, indent=1, default=str)
+        from imaginaire_tpu.resilience.retry import retry_call
+
+        def _write():
+            tmp = str(path) + ".partition.json.tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, indent=1, default=str)
+            os.replace(tmp, str(path) + ".partition.json")
+
+        retry_call(_write, label="partition_sidecar")
     except Exception as e:  # noqa: BLE001 — a sidecar must never fail a save
         import logging
 
@@ -192,12 +338,69 @@ def write_partition_sidecar(path, descriptor):
             "partition sidecar write failed: %s", e)
 
 
+def write_integrity_sidecar(path, integrity):
+    """``<ckpt>.integrity.json`` for checkpoints without a partition
+    descriptor. Master-only; never fails a save."""
+    import json
+
+    if not is_master():
+        return
+    try:
+        from imaginaire_tpu.resilience.retry import retry_call
+
+        def _write():
+            tmp = str(path) + ".integrity.json.tmp"
+            with open(tmp, "w") as f:
+                json.dump(integrity, f, indent=1, default=str)
+            os.replace(tmp, str(path) + ".integrity.json")
+
+        retry_call(_write, label="integrity_sidecar")
+    except Exception as e:  # noqa: BLE001
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "integrity sidecar write failed: %s", e)
+
+
 def read_partition_sidecar(path):
-    """The saved partition descriptor, or None (replicated / legacy)."""
+    """The saved partition descriptor, or None (replicated / legacy).
+    The ``integrity`` key (ISSUE 7 checksums sharing the file) is
+    stripped — descriptor comparisons stay byte-compatible with
+    pre-ISSUE-7 sidecars."""
     import json
     import os as _os
 
     sidecar = str(path) + ".partition.json"
+    if not _os.path.exists(sidecar):
+        return None
+    try:
+        with open(sidecar) as f:
+            payload = json.load(f)
+    except Exception:  # noqa: BLE001
+        return None
+    if isinstance(payload, dict):
+        payload = {k: v for k, v in payload.items() if k != "integrity"}
+        return payload or None
+    return payload
+
+
+def read_integrity_sidecar(path):
+    """The saved per-leaf checksums, or None (legacy checkpoint):
+    ``.partition.json``'s ``integrity`` key when present, else the
+    standalone ``.integrity.json``."""
+    import json
+    import os as _os
+
+    sidecar = str(path) + ".partition.json"
+    if _os.path.exists(sidecar):
+        try:
+            with open(sidecar) as f:
+                payload = json.load(f)
+            if isinstance(payload, dict) and payload.get("integrity"):
+                return payload["integrity"]
+        except Exception:  # noqa: BLE001
+            pass
+    sidecar = str(path) + ".integrity.json"
     if not _os.path.exists(sidecar):
         return None
     try:
@@ -207,18 +410,199 @@ def read_partition_sidecar(path):
         return None
 
 
-def load_checkpoint(path, target=None):
+# ------------------------------------------------------------ retention
+
+
+def gc_checkpoints(logdir, max_to_keep, protect=()):
+    """Retention GC: keep the newest ``max_to_keep`` checkpoints.
+
+    Never deletes the pointer target, anything in ``protect``, or the
+    newest checkpoint that carries integrity checksums (the last
+    *verifiable* one — fallback must always have somewhere to land).
+    Master-only; emits a ``ckpt/gc`` telemetry meta event naming what
+    was deleted."""
+    if not max_to_keep or int(max_to_keep) <= 0 or not is_master():
+        return []
+    entries = scan_checkpoints(logdir)
+    if len(entries) <= int(max_to_keep):
+        return []
+    protected = {os.path.abspath(str(p)) for p in protect}
+    pointer = os.path.join(logdir, _POINTER)
+    if os.path.exists(pointer):
+        try:
+            with open(pointer) as f:
+                pointed = f.read().strip()
+            if pointed:
+                protected.add(os.path.abspath(
+                    os.path.join(logdir, pointed)))
+        except OSError:
+            pass
+    # the newest verifiable checkpoint stays: it is where a corrupt
+    # pointer target falls back to
+    for _, _, path in reversed(entries):
+        if read_integrity_sidecar(path) is not None:
+            protected.add(os.path.abspath(path))
+            break
+    doomed = [path for _, _, path in entries[:-int(max_to_keep)]
+              if os.path.abspath(path) not in protected]
+    if not doomed:
+        return []
+    import logging
+    import shutil
+
+    from imaginaire_tpu.resilience.integrity import SIDECAR_SUFFIXES
+
+    deleted = []
+    for path in doomed:
+        try:
+            shutil.rmtree(path)
+        except OSError as e:
+            logging.getLogger(__name__).warning(
+                "checkpoint GC failed to delete %s: %s", path, e)
+            continue
+        for suffix in SIDECAR_SUFFIXES:
+            sidecar = path + suffix
+            if os.path.exists(sidecar):
+                try:
+                    os.remove(sidecar)
+                except OSError:
+                    pass
+        deleted.append(path)
+    if deleted:
+        tm = telemetry.get()
+        if tm.enabled:
+            tm.meta("ckpt/gc", deleted=[os.path.basename(p)
+                                        for p in deleted],
+                    kept=len(entries) - len(deleted),
+                    max_to_keep=int(max_to_keep))
+            tm.counter("resilience/ckpt_gc_deleted", len(deleted))
+        logging.getLogger(__name__).info(
+            "checkpoint GC deleted %d checkpoint(s) (max_to_keep=%d): %s",
+            len(deleted), int(max_to_keep),
+            [os.path.basename(p) for p in deleted])
+    return deleted
+
+
+# -------------------------------------------------------------- restore
+
+
+def load_checkpoint(path, target=None, verify=True):
     """Restore a state pytree; ``target`` gives structure/dtypes.
 
     Arrays come back as host numpy; callers ``device_put`` them with
     their own shardings (trainers re-shard on resume). This keeps
     restore layout-agnostic — a checkpoint written on one mesh shape
     loads on another.
+
+    ``verify`` is two-layered: the sidecar's raw-file digests are
+    checked with plain Python reads BEFORE orbax deserializes anything
+    (corrupt compressed chunks fed to a native decoder are a heap
+    hazard, not just a wrong answer), then the per-leaf checksums are
+    replayed against the restored arrays. Either mismatch raises
+    ``CheckpointIntegrityError``; checkpoints saved without checksums
+    restore unverified, as before.
     """
     import jax
 
+    integrity = read_integrity_sidecar(path) if verify else None
+    if verify:
+        from imaginaire_tpu.resilience.integrity import verify_files
+
+        verify_files(os.path.abspath(path),
+                     (integrity or {}).get("files"), context=str(path))
     with telemetry.span("ckpt_load"), ocp.PyTreeCheckpointer() as ckpt:
         if target is not None:
-            return ckpt.restore(os.path.abspath(path),
-                                item=jax.device_get(target))
-        return ckpt.restore(os.path.abspath(path))
+            payload = ckpt.restore(os.path.abspath(path),
+                                   item=jax.device_get(target))
+        else:
+            payload = ckpt.restore(os.path.abspath(path))
+    if verify:
+        from imaginaire_tpu.resilience.integrity import verify_tree
+
+        verify_tree(payload, integrity, context=str(path))
+        tm = telemetry.get()
+        if tm.enabled:
+            tm.meta("ckpt/verified", checkpoint=str(path),
+                    verified=integrity is not None,
+                    n_leaves=(integrity or {}).get("n_leaves"))
+    return payload
+
+
+def load_latest_verified(logdir, target=None, verify=True):
+    """The resume path with last-good fallback: restore the pointed
+    checkpoint, quarantining any candidate that is corrupt / truncated
+    / unrestorable and falling back to the next-newest until one
+    verifies.
+
+    Returns ``(payload, path, fallbacks)`` — ``payload`` None when the
+    logdir has no pointer (fresh run). Raises when a pointer exists but
+    EVERY candidate failed: resuming from scratch over a logdir full of
+    corrupt checkpoints must be an explicit operator decision, not a
+    silent restart."""
+    from imaginaire_tpu.resilience.integrity import (
+        CheckpointIntegrityError,
+        quarantine_checkpoint,
+    )
+
+    pointer = os.path.join(logdir, _POINTER)
+    if not os.path.exists(pointer):
+        return None, None, 0
+    try:
+        with open(pointer) as f:
+            pointed_name = f.read().strip()
+    except OSError:
+        pointed_name = ""
+    pointed = (os.path.abspath(os.path.join(logdir, pointed_name))
+               if pointed_name else None)
+    candidates = []
+    if pointed and os.path.exists(pointed):
+        candidates.append(pointed)
+    for _, _, path in reversed(scan_checkpoints(logdir)):
+        if os.path.abspath(path) != pointed:
+            candidates.append(os.path.abspath(path))
+    if not candidates:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "latest_checkpoint.txt names %r but no checkpoint exists in "
+            "%s", pointed_name, logdir)
+        return None, None, 0
+    tm = telemetry.get()
+    fallbacks = 0
+    errors = []
+    for cand in candidates:
+        try:
+            payload = load_checkpoint(cand, target=target, verify=verify)
+        except CheckpointIntegrityError as e:
+            errors.append(f"{cand}: {e}")
+            quarantine_checkpoint(cand, reason="integrity mismatch")
+            fallbacks += 1
+            _note_fallback(tm, cand, fallbacks, str(e))
+            continue
+        except Exception as e:  # noqa: BLE001 — truncated/unrestorable
+            errors.append(f"{cand}: {type(e).__name__}: {e}")
+            quarantine_checkpoint(cand,
+                                  reason=f"restore failed: "
+                                         f"{type(e).__name__}")
+            fallbacks += 1
+            _note_fallback(tm, cand, fallbacks, str(e))
+            continue
+        if fallbacks and tm.enabled:
+            tm.counter("resilience/ckpt_fallbacks", fallbacks)
+        return payload, cand, fallbacks
+    raise RuntimeError(
+        f"no verifiable checkpoint in {logdir}: every candidate failed "
+        f"to restore ({len(errors)} quarantined). Delete or repair the "
+        f"logdir to restart from scratch. Errors: "
+        + " | ".join(errors[:3]))
+
+
+def _note_fallback(tm, path, fallbacks, error):
+    import logging
+
+    if tm.enabled:
+        tm.meta("ckpt/fallback", skipped=str(path), fallbacks=fallbacks,
+                error=error[:500])
+    logging.getLogger(__name__).error(
+        "checkpoint %s failed to restore (%s); falling back to the "
+        "next-newest checkpoint", path, error[:500])

@@ -8,6 +8,9 @@ Checks (each can fail the gate):
 - D/G balance: more than ``--max-dg-breaches`` (default 0)
   ``health/dg_ratio_breach`` counter emissions;
 - hang dumps: any watchdog ``hang`` event;
+- fault tolerance (ISSUE 7): corrupt-checkpoint fallbacks beyond
+  ``--max-fallbacks`` (default 0), any ``resilience/resume_divergence``
+  meta event (always fatal), and any exhausted retry budget;
 - ``--require-health``: the run must actually carry ``health/*``
   counters (guards against a config that silently disabled diagnostics
   — a green gate over a blind run is worse than a red one).
@@ -35,10 +38,38 @@ from imaginaire_tpu.telemetry.report import (  # noqa: E402
 
 
 def check_health(summary, require_health=False, max_dg_breaches=0,
-                 max_recompiles=0, mem_budget_frac=None):
+                 max_recompiles=0, mem_budget_frac=None,
+                 max_fallbacks=0):
     """Return the list of failure strings for an aggregated summary."""
     failures = []
     health = summary.get("health") or {}
+    # Fault-tolerance gates (ISSUE 7): checkpoint fallbacks beyond the
+    # budget (default 0 — a healthy run never quarantines anything; the
+    # chaos legs pass --max-fallbacks 1 because they corrupt on
+    # purpose), and ANY resume-divergence event (a runstate sidecar
+    # disagreeing with its checkpoint means the resumed data stream is
+    # desynchronized from the RNG/step state — never tolerable).
+    res = summary.get("resilience") or {}
+    fallbacks = res.get("fallbacks", 0)
+    if max_fallbacks is not None and fallbacks > max_fallbacks:
+        skipped = [e.get("skipped") for e
+                   in res.get("fallback_events", [])]
+        failures.append(
+            f"{fallbacks} checkpoint fallback(s) after quarantine "
+            f"(allowed {max_fallbacks})"
+            + (f": skipped {skipped[:3]}" if skipped else ""))
+    for ev in res.get("divergence_events", []):
+        failures.append(
+            f"resume divergence: checkpoint iteration "
+            f"{ev.get('checkpoint_iteration')} disagrees with runstate "
+            f"sidecar iteration {ev.get('runstate_iteration')} "
+            f"({ev.get('checkpoint')})")
+    if res.get("retry_exhausted"):
+        labels = sorted({e.get("label") for e
+                         in res["retry_exhausted"]} - {None})
+        failures.append(
+            f"{len(res['retry_exhausted'])} retry budget(s) exhausted "
+            f"(labels {labels})")
     # XLA observability gates (ISSUE 5): post-warmup recompiles beyond
     # the budget (default 0 — a warm step loop must not re-specialize)
     # and, when --mem-budget-frac is given, a peak-HBM watermark past
@@ -103,6 +134,11 @@ def main(argv=None):
                     help="fail when the peak HBM watermark exceeds "
                          "this fraction of bytes_limit (default: no "
                          "memory gate)")
+    ap.add_argument("--max-fallbacks", type=int, default=0,
+                    help="tolerated corrupt-checkpoint fallbacks "
+                         "(resilience/ckpt_fallbacks; default 0 — "
+                         "chaos legs that corrupt on purpose pass 1). "
+                         "Resume-divergence events always fail.")
     ap.add_argument("--json", action="store_true",
                     help="print the verdict as JSON")
     args = ap.parse_args(argv)
@@ -117,9 +153,11 @@ def main(argv=None):
     failures = check_health(summary, require_health=args.require_health,
                             max_dg_breaches=args.max_dg_breaches,
                             max_recompiles=args.max_recompiles,
-                            mem_budget_frac=args.mem_budget_frac)
+                            mem_budget_frac=args.mem_budget_frac,
+                            max_fallbacks=args.max_fallbacks)
     health = summary.get("health") or {}
     xla = summary.get("xla") or {}
+    res = summary.get("resilience") or {}
     if args.json:
         print(json.dumps({
             "path": path,
@@ -137,6 +175,15 @@ def main(argv=None):
             # informational only — flow_cache/* counters never trip the
             # gate (an amortized-teacher run is not unhealthy)
             "flow_cache": summary.get("flow_cache") or {"present": False},
+            "resilience": {
+                "fallbacks": res.get("fallbacks", 0),
+                "quarantined": res.get("quarantined", 0),
+                "retries": res.get("retries", 0),
+                "preemptions": res.get("preemptions", 0),
+                "resume_divergence": len(res.get("divergence_events",
+                                                 [])),
+                "corrupt_flow_shards": res.get("corrupt_flow_shards", 0),
+            },
         }, indent=1, default=str))
     elif failures:
         for failure in failures:

@@ -64,7 +64,8 @@ class TestStoreAndKeys:
         flow2, conf2 = store.get(key)
         np.testing.assert_array_equal(flow2, flow)
         np.testing.assert_array_equal(conf2, conf)
-        assert store.stats() == {"hits": 1, "misses": 1, "hit_rate": 0.5}
+        assert store.stats() == {"hits": 1, "misses": 1,
+                                 "corrupt_shards": 0, "hit_rate": 0.5}
 
     def test_float16_storage_tolerance(self, rng, tmp_path):
         store = FlowCacheStore(str(tmp_path), "float16")

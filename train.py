@@ -8,10 +8,12 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
 
 import jax
 
-from imaginaire_tpu import telemetry
+from imaginaire_tpu import resilience, telemetry
+from imaginaire_tpu.resilience import chaos
 from imaginaire_tpu.config import Config, cfg_get
 from imaginaire_tpu.data import get_train_and_val_dataloader
 from imaginaire_tpu.parallel.mesh import mesh_from_config, master_only_print as print, set_mesh, honor_platform_env
@@ -67,6 +69,11 @@ def main():
     # the configured sinks (<logdir>/telemetry.jsonl by default); the
     # watchdog/trace knobs ride the same cfg section
     tm = telemetry.configure(cfg, logdir=logdir)
+    # fault-tolerance layer (resilience/): retry policy + chaos
+    # injection singleton, and the SIGTERM preemption guard that drains
+    # the in-flight step into an emergency checkpoint (ISSUE 7)
+    resilience.configure(cfg)
+    guard = resilience.install_preemption_guard(cfg)
 
     train_loader, val_loader = get_train_and_val_dataloader(cfg, seed=args.seed)
     trainer_cls = resolve(cfg.trainer.type, "Trainer")
@@ -97,6 +104,13 @@ def main():
 
     current_iteration = trainer.current_iteration
     current_epoch = trainer.current_epoch
+    # bit-exact resume (resilience/runstate.py): the checkpoint's
+    # runstate sidecar recorded how many batches of the interrupted
+    # epoch were already consumed; the first resumed epoch fast-forwards
+    # the loader past them instead of replaying the epoch from batch 0
+    # (the shuffle is seeded by (seed, epoch), so the skipped prefix is
+    # exactly what the killed run already trained on).
+    resume_offset = int(getattr(trainer, "resume_batch_in_epoch", 0) or 0)
     max_iter = cfg_get(cfg, "max_iter", 1000000)
     max_epoch = cfg_get(cfg, "max_epoch", 200)
     dis_steps = cfg_get(cfg.trainer, "dis_step", 1)
@@ -119,14 +133,22 @@ def main():
         train_loader.set_epoch(epoch)
         trainer.start_of_epoch(epoch)
         epoch_base[0] = current_iteration
+        if resume_offset:
+            if hasattr(feed, "fast_forward"):
+                feed.fast_forward(resume_offset)
+                print(f"Resume: fast-forwarding {resume_offset} "
+                      f"already-consumed batch(es) of epoch {epoch}")
+            resume_offset = 0
         # each next(feed) is timed as a data_wait span: with the
         # prefetcher healthy it is ~0; a starved queue shows up as the
         # dominant phase in the telemetry table instead of vanishing
         # into "slow steps"
         timed_feed = tm.timed_iter(
             feed, "data_wait", step_of=lambda index: epoch_base[0] + index)
+        data = None
         for it, data in enumerate(timed_feed):
             data = trainer.start_of_iteration(data, current_iteration)
+            data = chaos.get().maybe_nan_batch(data, current_iteration)
             for _ in range(dis_steps):
                 trainer.dis_update(data)
             for _ in range(gen_steps):
@@ -135,11 +157,31 @@ def main():
             if prefetching:
                 trainer.write_data_meters(feed.drain_stats())
             trainer.end_of_iteration(data, epoch, current_iteration)
+            chaos.get().maybe_sigterm(current_iteration)
+            if guard is not None and guard.triggered:
+                # preemption drain: the dispatched step already landed
+                # (save blocks on the live arrays), so commit an
+                # emergency checkpoint + run state and exit resumable
+                trainer.emergency_checkpoint(epoch, current_iteration,
+                                             guard)
+                # deterministic producer shutdown: closing the timed
+                # iterator unwinds the prefetcher's generator (stop flag
+                # + queue drain + producer join) before the process exits
+                timed_feed.close()
+                _finalize_run(trainer)
+                print(f"Preempted at iteration {current_iteration}; "
+                      f"emergency checkpoint committed — exit "
+                      f"{resilience.EXIT_PREEMPTED} (resumable)")
+                sys.exit(resilience.EXIT_PREEMPTED)
             if current_iteration >= max_iter:
                 print("Done with training!!!")
                 trainer.save_checkpoint(epoch, current_iteration)
                 _finalize_run(trainer)
                 return
+        if data is None:
+            # resumed exactly at an epoch boundary: every batch of this
+            # epoch was consumed before the kill — nothing to replay
+            continue
         trainer.end_of_epoch(data, epoch, current_iteration)
     print("Done with training!!!")
     _finalize_run(trainer)
