@@ -93,7 +93,22 @@ def _resilience_leg():
             counters.get("resilience/ckpt_quarantined", 0) or 0),
         "corrupt_flow_shards": int(
             counters.get("flow_cache/corrupt_shards", 0) or 0),
+        # pod coordination (ISSUE 8): which topology the leg ran in and
+        # whether any timed rendezvous expired — a desync in a bench
+        # leg means the numbers measured a half-dead pod
+        "process_count": _process_count(),
+        "cluster_desyncs": int(
+            counters.get("resilience/cluster_desyncs", 0) or 0),
     }
+
+
+def _process_count():
+    try:
+        import jax
+
+        return int(jax.process_count())
+    except Exception:  # noqa: BLE001
+        return 1
 
 
 def _parallel_leg(trainer=None):

@@ -19,6 +19,7 @@ from imaginaire_tpu.data import get_train_and_val_dataloader
 from imaginaire_tpu.parallel.mesh import (
     honor_platform_env,
     master_only_print as print,  # noqa: A001
+    maybe_init_distributed_from_env,
     mesh_from_config,
     set_mesh,
 )
@@ -45,6 +46,7 @@ def parse_args():
 
 def main():
     honor_platform_env()
+    maybe_init_distributed_from_env()
     args = parse_args()
     cfg = Config(args.config)
     # cfg.parallel.mesh_shape wins over the legacy runtime.mesh block
@@ -81,10 +83,14 @@ def main():
     if args.checkpoint:
         checkpoints = [args.checkpoint]
     elif args.checkpoint_logdir:
+        # quarantined ``*.corrupt`` renames and sidecar files must not
+        # enter the sweep — training already refused them
         checkpoints = sorted(
             p for p in glob.glob(os.path.join(args.checkpoint_logdir,
                                               "*checkpoint*"))
-            if os.path.isdir(p) or p.endswith((".ckpt", ".orbax")))
+            if (os.path.isdir(p) or p.endswith((".ckpt", ".orbax")))
+            and ".corrupt" not in os.path.basename(p)
+            and not p.endswith((".json", ".pkl")))
     else:
         raise SystemExit("pass --checkpoint or --checkpoint_logdir")
 
@@ -94,8 +100,22 @@ def main():
     if unknown:
         raise SystemExit(f"unknown --metrics {sorted(unknown)}; "
                          "supported: fid, kid, prdc")
+    from imaginaire_tpu.resilience import quarantine_checkpoint
+
     for checkpoint in checkpoints:
-        trainer.load_checkpoint(checkpoint, resume=True)
+        # every restore in the sweep runs the PR-7 integrity path; a
+        # checkpoint training would refuse is quarantined and SKIPPED
+        # (ISSUE 8 satellite) — one corrupt snapshot must not abort a
+        # whole sweep, and silently evaluating garbage weights is worse
+        try:
+            trainer.load_checkpoint(checkpoint, resume=True)
+        except Exception as e:  # noqa: BLE001 — corrupt/truncated
+            print(f"WARNING: skipping {checkpoint} — restore failed "
+                  f"({type(e).__name__}: {str(e)[:200]}); quarantining")
+            quarantine_checkpoint(checkpoint,
+                                  reason=f"eval restore failed: "
+                                         f"{type(e).__name__}")
+            continue
         print(f"Evaluating {checkpoint} (epoch {trainer.current_epoch}, "
               f"iteration {trainer.current_iteration})")
         if "fid" in metrics:

@@ -255,6 +255,16 @@ def default_config():
             mem_budget_frac=0.9,  # check_run_health watermark gate
             census_top=20,  # live-array census rows kept in reports
             oom_report=True,  # RESOURCE_EXHAUSTED forensics dump
+            # Persistent-compile-cache guard (ISSUE 8 satellite): the
+            # PR-7 bisect pinned a flaky NaN/SIGSEGV on executables
+            # DESERIALIZED from the jax persistent compile cache during
+            # warm-cache *resume* runs (fresh compiles never fail).
+            # off_on_resume (default) disables the cache only when the
+            # run restores a checkpoint — cold runs keep their compile
+            # amortization; 'off' always disables; 'on' never touches
+            # the configured cache. Tripping emits an
+            # xla/persistent_cache_disabled meta event.
+            persistent_cache="off_on_resume",  # on | off | off_on_resume
         ),
         # -- training-health diagnostics (diagnostics/): in-step norm
         # auditing (per-module grad/param norms, update/param ratio,
@@ -319,6 +329,27 @@ def default_config():
             emergency_checkpoint=True,
             emergency_deadline_s=60.0,
             retry=AttrDict(retries=3, backoff_s=0.1, max_backoff_s=2.0),
+            # multi-process hardening (resilience/cluster.py, ISSUE 8):
+            # with jax.distributed initialized, collectives that used to
+            # hang forever on a dead/stalled host become TIMED — a
+            # barrier that times out raises ClusterDesyncError naming
+            # the absent process index(es). barrier_timeout_s bounds
+            # every cluster rendezvous (checkpoint entry/commit, resume
+            # consensus, the per-step preemption vote); it must exceed
+            # the slowest legitimate straggler (a long compile or eval
+            # sweep on one host). sync_every_n_steps is the per-step
+            # preemption vote cadence (N iterations between votes; 0
+            # disables — a SIGTERM'd pod then hangs in the next
+            # collective instead of draining together). heartbeat_*
+            # feed the cross-host liveness record the watchdog dump
+            # reads to name the stalled process.
+            cluster=AttrDict(
+                enabled="auto",  # auto: active iff process_count > 1
+                barrier_timeout_s=300.0,
+                sync_every_n_steps=1,
+                heartbeat_interval_s=10.0,
+                heartbeat_timeout_s=60.0,
+            ),
         ),
         # -- chaos harness (resilience/chaos.py): deterministic fault
         # injection at configured steps so the recovery paths above stay
@@ -334,6 +365,18 @@ def default_config():
             nan_batch_at_step=None,
             io_error_at_step=None,
             io_error_site="flow_store",
+            # distributed chaos (ISSUE 8): kill-one-of-N delivers
+            # SIGTERM to the process whose index matches (the
+            # coordinated-drain path: every host must still exit
+            # EXIT_PREEMPTED with one emergency checkpoint), and
+            # stall-one-of-N freezes that process for stall_duration_s
+            # (the timed-barrier path: surviving hosts must raise
+            # ClusterDesyncError naming it instead of hanging).
+            kill_at_step=None,
+            kill_process_index=0,
+            stall_at_step=None,
+            stall_process_index=0,
+            stall_duration_s=30.0,
         ),
         # -- 2-D (data x model) parallelism (parallel/partition.py,
         # ISSUE 6). mesh_shape opts in: {"data": N, "model": M} (or an

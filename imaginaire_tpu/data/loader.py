@@ -79,7 +79,18 @@ class DataLoader:
             rng.shuffle(order)
         if not self.shard_by_process:
             return order
-        return order[get_rank()::get_world_size()]
+        world = get_world_size()
+        # every process must see the SAME number of items per epoch
+        # (ISSUE 8): the bare strided split hands early ranks one item
+        # more when len(dataset) is not divisible — on a pod that means
+        # one host finishes its epoch (and enters the end-of-epoch
+        # checkpoint barrier) while its peers are still blocked in a
+        # step collective waiting for it: a guaranteed desync every
+        # epoch. Truncating to the common floor (the contract __len__
+        # already promises) keeps all ranks in lockstep; the dropped
+        # remainder rotates with the epoch shuffle.
+        usable = (len(order) // world) * world
+        return order[:usable][get_rank()::world]
 
     def __iter__(self):
         if self.num_workers > 0:

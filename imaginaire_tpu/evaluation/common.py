@@ -35,10 +35,16 @@ def preprocess_for_inception(images):
 
 
 def _allgather_if_multihost(acts):
+    """Cross-host activation gather through the TIMED collective
+    (ISSUE 8): a host that died mid-sweep raises ClusterDesyncError
+    naming it on every survivor instead of parking the whole pod in
+    ``process_allgather`` forever."""
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+        from imaginaire_tpu.parallel.collectives import host_all_gather
 
-        return np.asarray(multihost_utils.process_allgather(acts)).reshape(
+        return np.asarray(
+            host_all_gather(acts, tiled=False,
+                            name="eval_activations")).reshape(
             -1, acts.shape[-1])
     return acts
 

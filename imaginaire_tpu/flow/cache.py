@@ -185,6 +185,9 @@ class FlowCacheStore:
             count = self.corrupt_shards
         try:
             os.replace(path, path + ".corrupt")
+        except FileNotFoundError:
+            # another host of a shared store already quarantined it
+            pass
         except OSError:
             try:
                 os.remove(path)
@@ -228,9 +231,22 @@ class FlowCacheStore:
         from imaginaire_tpu.resilience import retry_call
 
         path = self.path(key)
+        if os.path.exists(path):
+            # multi-writer shared directory (ISSUE 8): another host's
+            # producer already published this shard — content-addressed
+            # keys make its bytes equivalent, so skip the redundant
+            # write (and the rename-over-live-file hazard on
+            # non-POSIX-atomic shared filesystems)
+            return
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        # np.savez appends '.npz' unless the name already ends with it
-        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp.npz"
+        # tmp name unique across THREADS and HOSTS: pids collide between
+        # machines sharing a filesystem, so a random token joins the
+        # pid/tid pair (np.savez appends '.npz' unless the name already
+        # ends with it)
+        import uuid
+
+        tmp = (f"{path}.{os.getpid()}.{threading.get_ident()}."
+               f"{uuid.uuid4().hex[:8]}.tmp.npz")
 
         def _write():
             np.savez(tmp, flow=np.asarray(flow).astype(self.store_dtype),

@@ -34,22 +34,51 @@ def all_gather(x, axis_name=DATA_AXIS, axis=0, tiled=True):
     return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
-def host_all_gather(x):
+def host_all_gather(x, tiled=True, timeout_s=None, name="host_all_gather"):
     """Gather a per-process array across host processes (eval feature
-    gathering, ref: evaluation/common.py:68). Single-process: identity."""
+    gathering, ref: evaluation/common.py:68). Single-process: identity.
+
+    TIMED (ISSUE 8): a dead/stalled peer used to park every surviving
+    host inside ``process_allgather`` forever — the gather is preceded
+    by a timed rendezvous that raises ``ClusterDesyncError`` naming the
+    absent process instead. Once every process has passed the barrier,
+    the gather itself completes (the collective's participants are all
+    demonstrably alive and entering it together)."""
     if jax.process_count() == 1:
         return x
+    from imaginaire_tpu.resilience import cluster
+
+    cluster.timed_barrier(name, timeout_s=timeout_s)
     from jax.experimental import multihost_utils
 
-    return multihost_utils.process_allgather(x, tiled=True)
+    return multihost_utils.process_allgather(x, tiled=tiled)
 
 
-def barrier(name="barrier"):
-    """Cross-host rendezvous (ref: utils/io.py:120 dist.barrier)."""
+def barrier(name="barrier", timeout_s=None):
+    """Cross-host rendezvous (ref: utils/io.py:120 dist.barrier).
+
+    TIMED (ISSUE 8): delegates to ``resilience.cluster.timed_barrier``
+    — a process that never arrives within ``timeout_s`` (default
+    ``cfg.resilience.cluster.barrier_timeout_s``) raises
+    ``ClusterDesyncError`` naming the absent index(es) on every
+    survivor instead of hanging the pod. Single-process: no-op."""
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+        from imaginaire_tpu.resilience import cluster
 
-        multihost_utils.sync_global_devices(name)
+        cluster.timed_barrier(name, timeout_s=timeout_s)
+
+
+def host_psum(x, timeout_s=None, name="host_psum"):
+    """Sum a small host value across processes (health aggregation,
+    eval counters) with the same timed-rendezvous guard as
+    ``host_all_gather``. Single-process: identity."""
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return x
+    gathered = host_all_gather(np.asarray(x)[None], tiled=True,
+                               timeout_s=timeout_s, name=name)
+    return np.sum(np.asarray(gathered), axis=0)
 
 
 def fold_in_data_rank(key, axis_name=DATA_AXIS):

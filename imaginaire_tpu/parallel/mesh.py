@@ -44,11 +44,49 @@ def init_distributed(coordinator_address=None, num_processes=None, process_id=No
     """Initialize multi-host JAX (replaces dist.init_process_group, ref:
     imaginaire/utils/distributed.py:11-17). No-op for single-process runs."""
     if num_processes is not None and num_processes > 1:
+        import os
+
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") or \
+                jax.config.jax_platforms == "cpu":
+            # CPU pods (scripts/launch_local_pod.py, tests): cross-
+            # process collectives need the gloo transport; harmless to
+            # set, fatal to forget (collectives silently unavailable)
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:  # noqa: BLE001 — older jaxlib: no knob
+                pass
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
+
+
+def maybe_init_distributed_from_env():
+    """Initialize ``jax.distributed`` from ``IMAGINAIRE_DIST_*`` env
+    vars (ISSUE 8) — the contract ``scripts/launch_local_pod.py`` and
+    real pod launchers use to make every entry point (train.py,
+    inference.py, evaluate.py) pod-aware without CLI plumbing:
+
+      IMAGINAIRE_DIST_COORDINATOR   host:port of process 0
+      IMAGINAIRE_DIST_NUM_PROCESSES total process count
+      IMAGINAIRE_DIST_PROCESS_ID    this process's index
+
+    Must run BEFORE any jax backend initializes (entry points call it
+    right after ``honor_platform_env``). No-op when the vars are absent
+    or name a single process. Returns True when initialization ran."""
+    import os
+
+    n = os.environ.get("IMAGINAIRE_DIST_NUM_PROCESSES")
+    if not n or int(n) <= 1:
+        return False
+    init_distributed(
+        coordinator_address=os.environ.get("IMAGINAIRE_DIST_COORDINATOR"),
+        num_processes=int(n),
+        process_id=int(os.environ.get("IMAGINAIRE_DIST_PROCESS_ID", "0")),
+    )
+    return True
 
 
 def honor_platform_env():

@@ -84,16 +84,24 @@ def place_committed_batch(batch, mesh=None, axis=DATA_AXIS):
     replicated. Without a configured mesh (``peek_mesh()`` is None and
     no ``mesh`` given) this degrades to ``to_device``'s uncommitted
     ``jnp.asarray`` placement so single-device scripts keep working.
+
+    Multi-process (ISSUE 8): the loader batch is this HOST's slice of
+    the global batch (``DataLoader`` shards ``process_index::
+    process_count``); the leaves assemble into GLOBAL arrays via
+    ``jax.make_array_from_process_local_data`` — each host commits only
+    its addressable shards and the jitted step sees one global batch
+    sharded over the pod's ``data`` axis. This replaces the old
+    synchronous uncommitted-transfer fallback, which silently ran N
+    *independent* single-host programs (no gradient all-reduce at all)
+    on multi-process runs.
     """
     from imaginaire_tpu.utils.misc import to_device
 
     mesh = mesh if mesh is not None else peek_mesh()
-    if mesh is None or jax.process_count() > 1:
-        # multi-process: the loader batch is this HOST's slice of the
-        # global batch — committing it with a global-mesh spec would
-        # mislabel local data as the whole batch. The uncommitted path
-        # keeps the established per-host semantics there.
+    if mesh is None:
         return to_device(batch)
+    if jax.process_count() > 1:
+        return place_process_local_batch(batch, mesh, axis)
     shardings = batch_pytree_shardings(batch, mesh, axis)
     specs = jax.tree_util.tree_leaves(
         shardings, is_leaf=lambda s: isinstance(s, NamedSharding))
@@ -103,6 +111,53 @@ def place_committed_batch(batch, mesh=None, axis=DATA_AXIS):
         # program onto the full mesh — keep the uncommitted placement
         return to_device(batch)
     return jax.device_put(batch, shardings)
+
+
+def place_process_local_batch(batch, mesh, axis=DATA_AXIS):
+    """Assemble per-host batch slices into committed GLOBAL arrays.
+
+    Each array leaf whose leading dim the host's LOCAL device count on
+    ``axis`` divides becomes one global ``jax.Array`` sharded over the
+    pod-wide ``axis`` (global batch = concat of the hosts' slices in
+    process order — exactly the ``DataLoader``'s strided split
+    reassembled). Leaves that cannot shard locally are placed
+    replicated from local data — only correct for values identical
+    across hosts (epoch scalars, broadcast constants), which is what
+    indivisible leaves are in practice; per-host payloads belong in the
+    host-only half of the batch (``split_host_leaves``)."""
+    import numpy as np
+
+    # this host's share of the sharded axis (``local_mesh`` is the
+    # sub-mesh of this process's addressable devices)
+    try:
+        local_on_axis = dict(mesh.local_mesh.shape).get(axis, 0)
+    except Exception:  # noqa: BLE001 — no local devices in this mesh
+        local_on_axis = 0
+    axis_in_mesh = axis in dict(mesh.shape)
+
+    def place(x):
+        x = np.asarray(x)
+        spec = P()
+        if axis_in_mesh and x.ndim >= 1 and local_on_axis > 0 \
+                and x.shape[0] > 0 and x.shape[0] % local_on_axis == 0:
+            spec = P(axis, *([None] * (x.ndim - 1)))
+        elif x.ndim >= 1 and x.shape[0] > 1:
+            # replication assembles THIS host's value as the global
+            # one — wrong for per-host batch data. Batched leaves
+            # should divide the per-host device share; say so loudly
+            # instead of silently corrupting the global batch.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "multi-process batch leaf with leading dim %d does not "
+                "divide this host's %d device(s) on %r — placing "
+                "REPLICATED from local data, which is only correct for "
+                "host-identical values", x.shape[0], local_on_axis,
+                axis)
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree.map(place, batch)
 
 
 def data_axis_size(mesh=None, axis=DATA_AXIS):

@@ -24,7 +24,8 @@ the training entry point owns signal handlers.
 """
 
 from imaginaire_tpu.config import cfg_get
-from imaginaire_tpu.resilience import chaos
+from imaginaire_tpu.resilience import chaos, cluster
+from imaginaire_tpu.resilience.cluster import ClusterDesyncError
 from imaginaire_tpu.resilience.integrity import (
     CheckpointIntegrityError,
     quarantine_checkpoint,
@@ -49,10 +50,12 @@ from imaginaire_tpu.resilience.runstate import (
 
 __all__ = [
     "CheckpointIntegrityError",
+    "ClusterDesyncError",
     "EXIT_PREEMPTED",
     "PreemptionGuard",
     "build_runstate",
     "chaos",
+    "cluster",
     "configure",
     "install_preemption_guard",
     "quarantine_checkpoint",
@@ -87,9 +90,12 @@ def resilience_settings(cfg):
 
 def configure(cfg):
     """Install the process-wide resilience policy: retry defaults from
-    ``cfg.resilience.retry`` plus the chaos singleton from ``cfg.chaos``.
-    Returns the parsed settings."""
+    ``cfg.resilience.retry``, the chaos singleton from ``cfg.chaos``,
+    and the cluster coordination policy from ``cfg.resilience.cluster``
+    (timed barriers + preemption voting, ISSUE 8). Returns the parsed
+    settings."""
     settings = resilience_settings(cfg)
     set_default_policy(settings["retry"])
     chaos.configure(cfg)
+    settings["cluster"] = cluster.configure(cfg)
     return settings

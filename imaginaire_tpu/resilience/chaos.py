@@ -54,6 +54,17 @@ def chaos_settings(cfg):
         "io_error_at_step": step("io_error_at_step"),
         "io_error_site": str(cfg_get(ccfg, "io_error_site",
                                      "flow_store")),
+        # distributed chaos (ISSUE 8): one-of-N injections gated on the
+        # process index, driving the coordinated-drain and timed-barrier
+        # recovery paths in multi-process runs
+        "kill_at_step": step("kill_at_step"),
+        "kill_process_index": int(cfg_get(ccfg, "kill_process_index", 0)
+                                  or 0),
+        "stall_at_step": step("stall_at_step"),
+        "stall_process_index": int(cfg_get(ccfg, "stall_process_index",
+                                           0) or 0),
+        "stall_duration_s": float(cfg_get(ccfg, "stall_duration_s",
+                                          30.0) or 0.0),
     }
 
 
@@ -125,6 +136,47 @@ class ChaosMonkey:
                         step):
             os.kill(os.getpid(), signal.SIGTERM)
 
+    @staticmethod
+    def _my_process_index():
+        try:
+            from imaginaire_tpu.resilience import cluster
+
+            return cluster.process_index()
+        except Exception:  # noqa: BLE001 — no backend yet
+            return 0
+
+    def maybe_kill(self, step):
+        """Kill-one-of-N: deliver SIGTERM to THIS process only when its
+        index matches ``kill_process_index`` (ISSUE 8). The surviving
+        hosts must learn of the drain through the per-step preemption
+        vote and ALL exit ``EXIT_PREEMPTED`` behind one coordinated
+        emergency checkpoint — the recovery path this injection
+        exists to keep tested."""
+        if self.settings["kill_at_step"] is None \
+                or self._my_process_index() \
+                != self.settings["kill_process_index"]:
+            return
+        if self._should("kill", self.settings["kill_at_step"], step):
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_stall(self, step):
+        """Stall-one-of-N: freeze THIS process for ``stall_duration_s``
+        when its index matches (ISSUE 8). The other hosts' next timed
+        rendezvous (per-step preemption vote, checkpoint entry barrier)
+        must raise ``ClusterDesyncError`` naming this process instead
+        of hanging the pod."""
+        if self.settings["stall_at_step"] is None \
+                or self._my_process_index() \
+                != self.settings["stall_process_index"]:
+            return
+        if self._should("stall", self.settings["stall_at_step"], step):
+            import time
+
+            dur = self.settings["stall_duration_s"]
+            logger.warning("chaos: stalling process %d for %.1fs",
+                           self._my_process_index(), dur)
+            time.sleep(dur)
+
     def maybe_nan_batch(self, data, step):
         """Return ``data`` with its ``images`` leaf poisoned to NaN at
         the configured step (shallow copy; other leaves untouched)."""
@@ -170,6 +222,12 @@ class _NullChaos:
     enabled = False
 
     def maybe_sigterm(self, step):
+        pass
+
+    def maybe_kill(self, step):
+        pass
+
+    def maybe_stall(self, step):
         pass
 
     def maybe_nan_batch(self, data, step):

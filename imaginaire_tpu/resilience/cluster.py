@@ -1,0 +1,453 @@
+"""Multi-process cluster coordination: timed collectives, cross-host
+heartbeats, coordinated preemption, and resume consensus (ISSUE 8).
+
+The dominant real-world failure mode at multi-host scale is not a crash
+— it is a *hang*: one dead or stalled process parks every surviving
+host inside an untimed collective forever (characterized in PAPERS.md,
+arXiv:1810.11112). Everything here converts that silent hang into a
+loud, named, bounded failure:
+
+- ``timed_barrier(name, timeout_s)`` — a cluster rendezvous built on
+  the jax coordination service. Each process announces its arrival in
+  the service's KV store before waiting, so a timeout can read the
+  arrival record and raise ``ClusterDesyncError`` naming exactly which
+  process index(es) never showed up, instead of ``DEADLINE_EXCEEDED``
+  pointing at nobody.
+- ``ClusterHeartbeat`` — a daemon thread stamping ``hb/p<i>`` (wall
+  time + last step) every ``heartbeat_interval_s``. ``peer_status``
+  reads all stamps; the PR-2 hang watchdog folds it into its dump so a
+  distributed stall names the stalled process index, not just "no step
+  completed here".
+- ``coordinate_preemption(step, local_flag)`` — the per-step vote that
+  makes the PR-7 SIGTERM drain *collective*: a signal lands on ONE
+  host, but the emergency checkpoint is a collective orbax save, so
+  every host must enter it at the same iteration or the pod deadlocks
+  (the signaled host waits in the save barrier while the others wait
+  in the next step's psum). Each host writes its local flag for the
+  iteration, everyone rendezvouses, everyone reads the full vote set —
+  all hosts observe the same OR at the same step. The vote doubles as
+  a per-iteration liveness probe: a stalled peer trips the barrier
+  timeout and gets named.
+- ``agree_min(name, value)`` — resume consensus: every host publishes
+  the newest checkpoint iteration IT verified; the cluster restores
+  the min. A host whose local copy of a newer checkpoint failed
+  integrity follows the consensus instead of silently training from
+  different weights than its peers.
+
+Single-process (or uninitialized ``jax.distributed``) every entry
+point degrades to the trivial local answer — no RPC, no thread.
+
+The KV client is the coordination service jax.distributed already
+runs for device bootstrapping; no extra infrastructure. Tests inject a
+fake client via ``set_client_for_testing``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+from imaginaire_tpu.config import cfg_get
+
+logger = logging.getLogger(__name__)
+
+
+class ClusterDesyncError(RuntimeError):
+    """A timed cluster rendezvous expired: one or more processes never
+    arrived (dead, stalled, or partitioned). Carries the absent process
+    indices in ``.absent``."""
+
+    def __init__(self, message, absent=(), barrier=None):
+        super().__init__(message)
+        self.absent = tuple(absent)
+        self.barrier = barrier
+
+
+# test seam: a fake client (and fake process topology) installed by
+# tests/test_cluster.py so the protocol logic runs without spawning a
+# real 2-process jax.distributed cluster
+_CLIENT_OVERRIDE = None
+_TOPOLOGY_OVERRIDE = None  # (process_index, process_count)
+
+
+def set_client_for_testing(client, process_index=None, process_count=None):
+    global _CLIENT_OVERRIDE, _TOPOLOGY_OVERRIDE
+    _CLIENT_OVERRIDE = client
+    _TOPOLOGY_OVERRIDE = (None if process_index is None
+                          else (int(process_index), int(process_count)))
+
+
+def process_index():
+    if _TOPOLOGY_OVERRIDE is not None:
+        return _TOPOLOGY_OVERRIDE[0]
+    import jax
+
+    return jax.process_index()
+
+
+def process_count():
+    if _TOPOLOGY_OVERRIDE is not None:
+        return _TOPOLOGY_OVERRIDE[1]
+    import jax
+
+    return jax.process_count()
+
+
+def client():
+    """The coordination-service KV client, or None (single process /
+    distributed runtime not initialized)."""
+    if _CLIENT_OVERRIDE is not None:
+        return _CLIENT_OVERRIDE
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # noqa: BLE001 — no distributed runtime
+        return None
+
+
+def is_active():
+    return process_count() > 1 and client() is not None
+
+
+def cluster_settings(cfg):
+    """Parse ``cfg.resilience.cluster`` (see config.py defaults)."""
+    rcfg = cfg_get(cfg or {}, "resilience", None) or {}
+    ccfg = cfg_get(rcfg, "cluster", None) or {}
+    enabled = cfg_get(ccfg, "enabled", "auto")
+    if enabled == "auto":
+        enabled = process_count() > 1
+    return {
+        "enabled": bool(enabled),
+        "barrier_timeout_s": float(cfg_get(ccfg, "barrier_timeout_s",
+                                           300.0) or 0.0),
+        "sync_every_n_steps": int(cfg_get(ccfg, "sync_every_n_steps", 1)
+                                  or 0),
+        "heartbeat_interval_s": float(cfg_get(ccfg,
+                                              "heartbeat_interval_s",
+                                              10.0) or 0.0),
+        "heartbeat_timeout_s": float(cfg_get(ccfg, "heartbeat_timeout_s",
+                                             60.0) or 0.0),
+    }
+
+
+# process-wide settings installed by configure(); barrier calls that
+# don't pass an explicit timeout read it
+_SETTINGS = None
+
+
+def configure(cfg):
+    """Install the cluster policy (``resilience.configure`` calls this
+    alongside the retry/chaos setup). Returns the parsed settings."""
+    global _SETTINGS
+    _SETTINGS = cluster_settings(cfg)
+    if _SETTINGS["enabled"] and is_active():
+        logger.info("cluster coordination active: process %d/%d, "
+                    "barrier timeout %.1fs, preempt sync every %d "
+                    "step(s)", process_index(), process_count(),
+                    _SETTINGS["barrier_timeout_s"],
+                    _SETTINGS["sync_every_n_steps"])
+    return _SETTINGS
+
+
+def settings():
+    return _SETTINGS if _SETTINGS is not None else cluster_settings({})
+
+
+def default_timeout_s():
+    return settings()["barrier_timeout_s"]
+
+
+# ------------------------------------------------------ timed barrier
+
+# per-name invocation counters: barrier ids must be unique per
+# rendezvous, and a timed-out id must never be reused (the coordination
+# service considers it failed)
+_BARRIER_EPOCH = {}
+_BARRIER_LOCK = threading.Lock()
+
+
+def _next_epoch(name):
+    with _BARRIER_LOCK:
+        k = _BARRIER_EPOCH.get(name, 0)
+        _BARRIER_EPOCH[name] = k + 1
+    return k
+
+
+def timed_barrier(name, timeout_s=None, tag=None):
+    """Cluster rendezvous that raises instead of hanging.
+
+    Every process announces itself under ``arrive/<id>/p<i>`` and then
+    waits at the service barrier. On ``DEADLINE_EXCEEDED`` the arrival
+    record names the process(es) that never made it — the difference
+    between "the pod hung" and "process 3 is dead, restart it".
+
+    ``tag`` pins the barrier id (callers with a natural unique key, e.g.
+    the checkpoint iteration); otherwise a per-name counter keeps
+    repeated rendezvous distinct. No-op when single-process.
+    """
+    c = client()
+    n = process_count()
+    if n <= 1 or c is None:
+        return
+    timeout_s = default_timeout_s() if timeout_s is None else float(
+        timeout_s)
+    bid = f"{name}:{tag if tag is not None else _next_epoch(name)}"
+    i = process_index()
+    try:
+        c.key_value_set(f"arrive/{bid}/p{i}", f"{time.time():.3f}",
+                        allow_overwrite=True)
+    except Exception as e:  # noqa: BLE001 — arrival record best-effort
+        logger.warning("cluster: arrival record for %s failed: %s", bid,
+                       e)
+    try:
+        c.wait_at_barrier(f"barrier/{bid}", int(max(timeout_s, 0.001)
+                                                * 1000))
+    except Exception as e:
+        arrived = _arrivals(c, bid)
+        absent = sorted(set(range(n)) - set(arrived))
+        _desync_event(bid, absent, arrived, timeout_s, str(e))
+        raise ClusterDesyncError(
+            f"cluster barrier {name!r} timed out after {timeout_s:g}s: "
+            f"process(es) {absent or '<unknown>'} absent "
+            f"(arrived: {sorted(arrived)} of {n}; this is process {i}). "
+            f"One process is dead or stalled — every host should exit "
+            f"and the supervisor restart the pod.",
+            absent=absent, barrier=name) from e
+    # rendezvous done on every process: each cleans its own arrival key
+    try:
+        c.key_value_delete(f"arrive/{bid}/p{i}")
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _arrivals(c, bid):
+    try:
+        entries = c.key_value_dir_get(f"arrive/{bid}/")
+    except Exception:  # noqa: BLE001
+        return []
+    out = []
+    for key, _ in entries:
+        base = key.rsplit("/", 1)[-1]
+        if base.startswith("p"):
+            try:
+                out.append(int(base[1:]))
+            except ValueError:
+                continue
+    return out
+
+
+def _desync_event(bid, absent, arrived, timeout_s, error):
+    from imaginaire_tpu import telemetry
+
+    tm = telemetry.get()
+    if tm.enabled:
+        tm.meta("resilience/cluster_desync", barrier=bid,
+                absent=list(absent), arrived=sorted(arrived),
+                timeout_s=timeout_s, process=process_index(),
+                error=error[:300])
+        tm.counter("resilience/cluster_desyncs", 1)
+        tm.flush()  # the evidence must land before the process exits
+    logger.error("cluster barrier %s timed out (%.1fs): absent %s, "
+                 "arrived %s", bid, timeout_s, absent, sorted(arrived))
+
+
+# ------------------------------------------------- preemption voting
+
+def coordinate_preemption(step, local_flag, timeout_s=None):
+    """Collective OR of per-host preemption flags at iteration ``step``.
+
+    The SIGTERM drain (PR 7) must be entered by EVERY host at the same
+    iteration: the emergency save is a collective, so a host draining
+    alone deadlocks against peers running the next step. Protocol:
+    write the local flag for this step, rendezvous, read the complete
+    vote set — the barrier guarantees every vote is visible to every
+    reader, so all hosts compute the same OR for the same step.
+
+    Single-process: returns ``local_flag`` unchanged, no RPC.
+    Raises ``ClusterDesyncError`` when a peer never votes (stalled) —
+    the per-step vote doubles as the pod's liveness probe.
+    """
+    c = client()
+    n = process_count()
+    if n <= 1 or c is None:
+        return bool(local_flag)
+    i = process_index()
+    step = int(step)
+    try:
+        c.key_value_set(f"psync/{step}/p{i}", "1" if local_flag else "0",
+                        allow_overwrite=True)
+    except Exception as e:  # noqa: BLE001
+        logger.warning("cluster: preemption vote write failed: %s", e)
+    try:
+        timed_barrier("psync", timeout_s=timeout_s, tag=step)
+    except ClusterDesyncError:
+        raise
+    votes = {}
+    try:
+        for key, value in c.key_value_dir_get(f"psync/{step}/"):
+            base = key.rsplit("/", 1)[-1]
+            if base.startswith("p"):
+                votes[int(base[1:])] = value.strip() == "1"
+    except Exception as e:  # noqa: BLE001 — the local flag still counts
+        logger.warning("cluster: preemption vote read failed: %s", e)
+    # bounded KV footprint: each process retires its own vote from two
+    # steps ago (the current step's keys must survive slow readers)
+    try:
+        c.key_value_delete(f"psync/{step - 2}/p{i}")
+    except Exception:  # noqa: BLE001
+        pass
+    flagged = sorted(p for p, v in votes.items() if v)
+    if flagged and not local_flag:
+        from imaginaire_tpu import telemetry
+
+        tm = telemetry.get()
+        if tm.enabled:
+            tm.meta("resilience/preempt_remote", step=step,
+                    flagged=flagged, process=i)
+        logger.warning("cluster: process(es) %s flagged preemption at "
+                       "step %d — joining the coordinated drain",
+                       flagged, step)
+    return bool(local_flag) or bool(flagged)
+
+
+# ---------------------------------------------------- resume consensus
+
+def agree_min(name, value, extra=None, timeout_s=None):
+    """Publish ``value`` (an int; -1 = "nothing local") and return
+    ``(consensus, votes)`` where consensus is the min over processes
+    that published >= 0 and votes maps process index -> (value, extra).
+
+    The resume path uses this with the newest checkpoint iteration each
+    host *verified*: min-over-verified is the newest state EVERY host
+    can restore, so a host whose local copy of a newer checkpoint fails
+    integrity follows the consensus instead of silently diverging.
+
+    Single-process: ``(value, {0: (value, extra)})``.
+    """
+    c = client()
+    n = process_count()
+    if n <= 1 or c is None:
+        return int(value), {0: (int(value), extra)}
+    i = process_index()
+    epoch = _next_epoch(f"agree/{name}")
+    payload = json.dumps({"v": int(value), "x": extra})
+    try:
+        c.key_value_set(f"agree/{name}/{epoch}/p{i}", payload,
+                        allow_overwrite=True)
+    except Exception as e:  # noqa: BLE001
+        logger.warning("cluster: agree(%s) publish failed: %s", name, e)
+    timed_barrier(f"agree_{name}", timeout_s=timeout_s, tag=epoch)
+    votes = {}
+    try:
+        for key, val in c.key_value_dir_get(f"agree/{name}/{epoch}/"):
+            base = key.rsplit("/", 1)[-1]
+            if base.startswith("p"):
+                rec = json.loads(val)
+                votes[int(base[1:])] = (int(rec["v"]), rec.get("x"))
+    except Exception as e:  # noqa: BLE001
+        logger.warning("cluster: agree(%s) read failed: %s", name, e)
+        votes[i] = (int(value), extra)
+    try:
+        c.key_value_delete(f"agree/{name}/{epoch}/p{i}")
+    except Exception:  # noqa: BLE001
+        pass
+    valid = [v for v, _ in votes.values() if v >= 0]
+    consensus = min(valid) if valid else -1
+    return consensus, votes
+
+
+# --------------------------------------------------------- heartbeats
+
+class ClusterHeartbeat(threading.Thread):
+    """Daemon thread stamping this process's liveness into the KV store
+    so *other* hosts' watchdog dumps can name a stalled peer."""
+
+    def __init__(self, interval_s=10.0):
+        super().__init__(daemon=True, name="cluster-heartbeat")
+        self.interval_s = max(float(interval_s), 0.5)
+        self._stop_event = threading.Event()
+
+    def run(self):
+        c = client()
+        if c is None:
+            return
+        i = process_index()
+        while not self._stop_event.wait(self.interval_s):
+            from imaginaire_tpu import telemetry
+
+            stamp = json.dumps({"t": round(time.time(), 3),
+                                "step": telemetry.get().last_step})
+            try:
+                c.key_value_set(f"hb/p{i}", stamp, allow_overwrite=True)
+            except Exception as e:  # noqa: BLE001 — liveness best-effort
+                logger.debug("cluster heartbeat write failed: %s", e)
+
+    def stop(self):
+        self._stop_event.set()
+
+
+_HEARTBEAT = None
+
+
+def start_heartbeat(cfg=None):
+    """Start (once) the heartbeat thread; no-op single-process."""
+    global _HEARTBEAT
+    s = cluster_settings(cfg) if cfg is not None else settings()
+    if not s["enabled"] or not is_active() \
+            or s["heartbeat_interval_s"] <= 0:
+        return None
+    if _HEARTBEAT is None or not _HEARTBEAT.is_alive():
+        _HEARTBEAT = ClusterHeartbeat(s["heartbeat_interval_s"])
+        _HEARTBEAT.start()
+    return _HEARTBEAT
+
+
+def peer_status(stale_after_s=None):
+    """{process_index: {"t", "step", "age_s", "stalled"}} from the
+    heartbeat record, or None when not a multi-process run. Processes
+    with NO stamp at all are reported with ``t None, stalled True`` —
+    a host that never heartbeated is the prime suspect."""
+    c = client()
+    n = process_count()
+    if n <= 1 or c is None:
+        return None
+    stale_after_s = (settings()["heartbeat_timeout_s"]
+                     if stale_after_s is None else float(stale_after_s))
+    now = time.time()
+    out = {}
+    try:
+        entries = c.key_value_dir_get("hb/")
+    except Exception:  # noqa: BLE001
+        entries = []
+    for key, value in entries:
+        base = key.rsplit("/", 1)[-1]
+        if not base.startswith("p"):
+            continue
+        try:
+            idx = int(base[1:])
+            rec = json.loads(value)
+        except ValueError:
+            continue
+        age = now - float(rec.get("t", 0))
+        out[idx] = {"t": rec.get("t"), "step": rec.get("step"),
+                    "age_s": round(age, 1),
+                    "stalled": age > stale_after_s}
+    for idx in range(n):
+        if idx not in out:
+            out[idx] = {"t": None, "step": None, "age_s": None,
+                        "stalled": True}
+    return out
+
+
+def stalled_peers(stale_after_s=None):
+    """Sorted indices of peers whose heartbeat is stale (excluding this
+    process); [] single-process."""
+    status = peer_status(stale_after_s)
+    if not status:
+        return []
+    me = process_index()
+    return sorted(i for i, rec in status.items()
+                  if i != me and rec["stalled"])

@@ -37,14 +37,49 @@ SIDECAR_SUFFIXES = (".partition.json", ".integrity.json",
                     ".runstate.json", ".ema_bn.pkl")
 
 
+def sidecar_files(path):
+    """Existing sidecar paths for a checkpoint: the fixed suffixes plus
+    the per-host ``.runstate.p<i>.json`` family (ISSUE 8) — quarantine
+    and GC must move/delete the whole set, discovered by glob so a pod
+    of any size is covered."""
+    import glob as _glob
+
+    path = str(path)
+    out = [path + s for s in SIDECAR_SUFFIXES
+           if os.path.exists(path + s)]
+    out.extend(sorted(_glob.glob(_glob.escape(path)
+                                 + ".runstate.p*.json")))
+    return out
+
+
 class CheckpointIntegrityError(RuntimeError):
     """A restored checkpoint's bytes do not match its saved checksums."""
 
 
 def _leaf_record(leaf):
     """(record dict, skip reason). Non-addressable / object leaves are
-    skipped with a reason instead of forcing a gather."""
+    skipped with a reason instead of forcing a gather — EXCEPT fully
+    replicated multi-process arrays (the pod DP steady state, ISSUE 8):
+    the local replica IS the global value, so per-leaf checksums keep
+    covering pod checkpoints instead of degrading to file digests
+    only."""
     if not getattr(leaf, "is_fully_addressable", True):
+        if getattr(leaf, "is_fully_replicated", False):
+            try:
+                arr = np.asarray(leaf.addressable_data(0))
+                if arr.dtype == object:
+                    return None, "object_dtype"
+                # ascontiguousarray promotes 0-d to (1,) — record the
+                # promoted shape, matching what the addressable path
+                # (and restore-time verification) computes
+                arr = np.ascontiguousarray(arr)
+                return {
+                    "crc": int(zlib.crc32(arr.tobytes())),
+                    "shape": [int(s) for s in arr.shape],
+                    "dtype": str(arr.dtype),
+                }, None
+            except Exception:  # noqa: BLE001
+                return None, "not_fully_addressable"
         return None, "not_fully_addressable"
     try:
         import jax
@@ -204,11 +239,27 @@ def verify_files(root, records, context=""):
 def quarantine_checkpoint(path, reason="corrupt"):
     """Rename a corrupt checkpoint (and its sidecars) out of the resume
     scan: ``<ckpt>`` -> ``<ckpt>.corrupt`` (numbered on collision).
-    Returns the quarantine path, or None when nothing was moved."""
+    Returns the quarantine path, or None when nothing was moved.
+
+    Multi-process (ISSUE 8): only process 0 renames — on a shared
+    checkpoint directory a non-master rename would yank the files out
+    from under peers mid-verification; the master's quarantine is
+    cluster-wide truth and the resume consensus handles any host that
+    raced past it."""
     from imaginaire_tpu import telemetry
+    from imaginaire_tpu.parallel.mesh import is_master
 
     path = str(path)
     if not os.path.exists(path):
+        return None
+    if not is_master():
+        logger.error("corrupt checkpoint %s detected on process >0 "
+                     "(%s); master owns the quarantine rename", path,
+                     reason)
+        tm = telemetry.get()
+        if tm.enabled:
+            tm.meta("ckpt/quarantine_deferred", checkpoint=path,
+                    reason=str(reason))
         return None
     target = path + ".corrupt"
     n = 0
@@ -222,13 +273,11 @@ def quarantine_checkpoint(path, reason="corrupt"):
         logger.error("failed to quarantine corrupt checkpoint %s: %s",
                      path, e)
         return None
-    for sidecar_suffix in SIDECAR_SUFFIXES:
-        sidecar = path + sidecar_suffix
-        if os.path.exists(sidecar):
-            try:
-                os.replace(sidecar, path + suffix + sidecar_suffix)
-            except OSError:  # the data dir moved; sidecars best-effort
-                pass
+    for sidecar in sidecar_files(path):
+        try:
+            os.replace(sidecar, path + suffix + sidecar[len(path):])
+        except OSError:  # the data dir moved; sidecars best-effort
+            pass
     tm = telemetry.get()
     if tm.enabled:
         tm.meta("ckpt/quarantined", checkpoint=path, quarantine=target,

@@ -102,6 +102,33 @@ class PreemptionGuard:
     def triggered(self):
         return self._triggered.is_set()
 
+    def trigger_remote(self, flagged=()):
+        """Join a drain another host initiated (ISSUE 8): the per-step
+        preemption vote observed a peer's SIGTERM flag. Sets the local
+        flag and arms the same deadline timer the signal handler would
+        — the collective emergency save must not be allowed to wedge
+        past the grace period on ANY host."""
+        first = not self._triggered.is_set()
+        self._triggered.set()
+        if not first:
+            return
+        from imaginaire_tpu import telemetry
+
+        tm = telemetry.get()
+        if tm.enabled:
+            tm.meta("resilience/preempt_remote_trigger",
+                    flagged=list(flagged), deadline_s=self.deadline_s)
+            tm.counter("resilience/preemptions", 1)
+        logger.warning(
+            "peer process(es) %s flagged preemption: joining the "
+            "coordinated drain (deadline %.1fs)",
+            list(flagged), self.deadline_s)
+        if self.deadline_s > 0:
+            self._timer = threading.Timer(self.deadline_s,
+                                          self._deadline_expired)
+            self._timer.daemon = True
+            self._timer.start()
+
     def disarm(self):
         """Cancel the deadline timer — call once the emergency
         checkpoint has committed."""

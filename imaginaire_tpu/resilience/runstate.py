@@ -28,7 +28,15 @@ RUNSTATE_VERSION = 1
 _SUFFIX = ".runstate.json"
 
 
-def runstate_path(checkpoint_path):
+def runstate_path(checkpoint_path, process_index=0):
+    """Process 0's sidecar keeps the legacy ``.runstate.json`` name
+    (single-host checkpoints stay byte-compatible); other hosts get
+    ``.runstate.p<i>.json`` (ISSUE 8: the monitor/telemetry halves of
+    the run state are per-host — restoring process 3 with process 0's
+    EWMA history would be wrong, and before this every non-master
+    host silently lost its half)."""
+    if process_index:
+        return f"{checkpoint_path}.runstate.p{int(process_index)}.json"
     return str(checkpoint_path) + _SUFFIX
 
 
@@ -45,13 +53,13 @@ def build_runstate(epoch, iteration, batch_in_epoch, monitor=None,
 
 
 def write_runstate(checkpoint_path, runstate):
-    """Master-only sidecar write; failures degrade to a warning (a
-    missing runstate means a coarse resume, never a failed save)."""
-    from imaginaire_tpu.parallel.mesh import is_master
+    """Per-host sidecar write (ISSUE 8: every process persists its OWN
+    host-side state — process 0 under the legacy name, process i under
+    ``.runstate.p<i>.json``); failures degrade to a warning (a missing
+    runstate means a coarse resume, never a failed save)."""
+    from imaginaire_tpu.parallel.mesh import get_rank
 
-    if not is_master():
-        return None
-    path = runstate_path(checkpoint_path)
+    path = runstate_path(checkpoint_path, get_rank())
     try:
         from imaginaire_tpu.resilience.retry import retry_call
 
@@ -69,15 +77,26 @@ def write_runstate(checkpoint_path, runstate):
         return None
 
 
-def read_runstate(checkpoint_path):
-    """The saved run state, or None (legacy checkpoint / unreadable)."""
-    path = runstate_path(checkpoint_path)
-    if not os.path.exists(path):
-        return None
-    try:
-        with open(path) as f:
-            return json.load(f)
-    except (OSError, ValueError) as e:
-        logger.warning("unreadable runstate sidecar %s: %s (resuming "
-                       "with a coarse epoch restart)", path, e)
-        return None
+def read_runstate(checkpoint_path, process_index=None):
+    """The saved run state for this host, or None (legacy checkpoint /
+    unreadable). A non-zero process whose own sidecar is missing (a
+    checkpoint written before the pod grew, or by fewer hosts) falls
+    back to the master sidecar — the epoch/iteration/batch position in
+    it is cluster-wide truth; only the monitor/telemetry halves are
+    per-host color."""
+    if process_index is None:
+        from imaginaire_tpu.parallel.mesh import get_rank
+
+        process_index = get_rank()
+    for idx in dict.fromkeys((int(process_index), 0)):
+        path = runstate_path(checkpoint_path, idx)
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            logger.warning("unreadable runstate sidecar %s: %s (resuming "
+                           "with a coarse epoch restart)", path, e)
+            return None
+    return None

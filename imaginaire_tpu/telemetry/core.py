@@ -514,20 +514,72 @@ class Telemetry:
 
     # ----------------------------------------------------- hang dumping
 
+    @staticmethod
+    def _process_identity():
+        """"p<i>/<n>" for the dump header — which HOST's dump this is
+        (ISSUE 8 satellite: the per-process jsonl suffix carried the
+        index, the dump header did not; aggregating pod dumps without
+        it meant guessing)."""
+        try:
+            import jax
+
+            return f"p{jax.process_index()}/{jax.process_count()}"
+        except Exception:  # noqa: BLE001 — no backend yet
+            return "p0/1"
+
+    @staticmethod
+    def _cluster_liveness():
+        """(header line, stalled indices) from the cross-host heartbeat
+        record, or (None, []) single-process — a distributed hang dump
+        should name the stalled PROCESS, not just show local threads
+        parked in a collective."""
+        try:
+            from imaginaire_tpu.resilience import cluster
+
+            status = cluster.peer_status()
+            if not status:
+                return None, []
+            stalled = [i for i, rec in sorted(status.items())
+                       if rec["stalled"]]
+            parts = []
+            for i, rec in sorted(status.items()):
+                if rec["t"] is None:
+                    parts.append(f"p{i}: no heartbeat")
+                else:
+                    parts.append(f"p{i}: {rec['age_s']:.0f}s ago "
+                                 f"(step {rec['step']})"
+                                 + (" STALLED" if rec["stalled"] else ""))
+            return "peer heartbeats: " + "; ".join(parts), stalled
+        except Exception:  # noqa: BLE001 — liveness is best-effort
+            return None, []
+
     def dump_stacks(self, reason):
         """Dump every Python thread's stack to the sinks and stderr —
-        the watchdog's payload, also callable on demand."""
+        the watchdog's payload, also callable on demand. The header
+        names this process's index/count and, on multi-process runs,
+        every peer's last heartbeat (the stalled process index is the
+        first thing a pod hang investigation needs)."""
         names = {t.ident: t.name for t in threading.enumerate()}
         stacks = {}
         for ident, frame in sys._current_frames().items():
             name = names.get(ident, f"thread-{ident}")
             stacks[name] = traceback.format_stack(frame)
+        proc = self._process_identity()
+        liveness, stalled = self._cluster_liveness()
         event = {"kind": "hang", "t": time.time(), "reason": reason,
-                 "step": self.last_step, "stacks": stacks}
+                 "step": self.last_step, "process": proc,
+                 "stacks": stacks}
+        if liveness is not None:
+            event["peer_heartbeats"] = liveness
+            event["stalled_processes"] = stalled
         with self._lock:
             self._events.append(event)
-        lines = [f"=== telemetry hang dump: {reason} "
+        lines = [f"=== telemetry hang dump [{proc}]: {reason} "
                  f"(last step {self.last_step}) ==="]
+        if liveness is not None:
+            lines.append(liveness)
+            if stalled:
+                lines.append(f"!! likely stalled process(es): {stalled}")
         for name, frames in stacks.items():
             lines.append(f"--- thread {name} ---")
             lines.extend(f.rstrip("\n") for f in frames)

@@ -55,6 +55,8 @@ def summarize(events):
     chaos_events = []
     gc_events = []
     retry_exhausted = []
+    desync_events = []
+    consensus_events = []
     meta = {}
     hangs = []
     t_min = t_max = None
@@ -100,10 +102,16 @@ def summarize(events):
             elif name == "resilience/resume_divergence":
                 divergence_events.append(ev)
             elif name in ("resilience/preempt_signal",
-                          "resilience/preempt_deadline_expired"):
+                          "resilience/preempt_deadline_expired",
+                          "resilience/preempt_remote",
+                          "resilience/preempt_remote_trigger"):
                 preempt_events.append(ev)
             elif name == "resilience/retry_exhausted":
                 retry_exhausted.append(ev)
+            elif name == "resilience/cluster_desync":
+                desync_events.append(ev)
+            elif name == "resilience/consensus_resume":
+                consensus_events.append(ev)
             elif str(name).startswith("chaos/"):
                 chaos_events.append(ev)
             meta[ev.get("name", "?")] = ev
@@ -207,6 +215,14 @@ def summarize(events):
         "fallback_events": fallback_events,
         "chaos_events": chaos_events,
         "gc_events": gc_events,
+        # pod coordination (ISSUE 8): desyncs gate check_run_health;
+        # consensus overrides are informational (a host following the
+        # cluster's agreed checkpoint is the machinery WORKING)
+        "cluster_desyncs": int(
+            counters.get("resilience/cluster_desyncs", (0, None))[0]
+            or 0) or len(desync_events),
+        "desync_events": desync_events,
+        "consensus_events": consensus_events,
     }
     return {"phases": table, "counters": counters, "meta": meta,
             "hangs": hangs, "wall_s": wall_s, "health": health,
@@ -348,6 +364,15 @@ def _resilience_section(s):
                      f"{ev.get('iteration')} "
                      f"(runstate: {ev.get('runstate')}, batch offset "
                      f"{ev.get('batch_in_epoch', 0)})")
+    for ev in r.get("desync_events", []):
+        lines.append(f"!! cluster desync: barrier {ev.get('barrier')} "
+                     f"absent process(es) {ev.get('absent')} "
+                     f"(observed by p{ev.get('process')})")
+    for ev in r.get("consensus_events", []):
+        lines.append(f"- resume consensus override: local iter "
+                     f"{ev.get('local_iteration')} -> cluster "
+                     f"{ev.get('consensus')} "
+                     f"({ev.get('consensus_checkpoint')})")
     if r.get("retries"):
         lines.append(f"- transient-IO retries: {r['retries']}"
                      + (f" (!! {len(r['retry_exhausted'])} exhausted)"

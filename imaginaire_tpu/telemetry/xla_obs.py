@@ -126,6 +126,59 @@ def xla_obs_settings(cfg):
     }
 
 
+def apply_persistent_cache_policy(cfg, resuming=False):
+    """Guard the known-bad persistent-compile-cache deserialize path
+    (ISSUE 8 satellite). The PR-7 chaos-leg bisect reproduced flaky NaN
+    losses / SIGSEGV when the spade step executables were DESERIALIZED
+    from the jax persistent compile cache during a warm-cache resume —
+    fresh compiles never failed (clean HEAD, ~20-run bisect; see
+    CHANGES.md PR 7). Until the upstream deserialize bug is fixed, a
+    resumed run must not pay a crash lottery for compile amortization.
+
+    ``cfg.xla_obs.persistent_cache``:
+      - ``on``            — never touch the configured cache
+      - ``off``           — always disable it
+      - ``off_on_resume`` — (default) disable only when ``resuming``
+
+    Call BEFORE the first compile. Returns True when the cache was
+    disabled; emits an ``xla/persistent_cache_disabled`` meta event so
+    the run's jsonl records why its compiles were cold."""
+    import jax
+
+    ocfg = cfg_get(cfg or {}, "xla_obs", None) or {}
+    mode = str(cfg_get(ocfg, "persistent_cache",
+                       "off_on_resume")).lower()
+    if mode not in ("on", "off", "off_on_resume"):
+        logger.warning("unknown xla_obs.persistent_cache=%r; treating "
+                       "as off_on_resume", mode)
+        mode = "off_on_resume"
+    trip = mode == "off" or (mode == "off_on_resume" and bool(resuming))
+    if not trip:
+        return False
+    import os as _os
+
+    previous = (jax.config.jax_compilation_cache_dir
+                or _os.environ.get("JAX_COMPILATION_CACHE_DIR"))
+    jax.config.update("jax_compilation_cache_dir", None)
+    # the env var re-arms the cache in child processes this run spawns
+    # (dryrun legs, pod launchers) — scrub it too
+    _os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+    if previous:
+        from imaginaire_tpu import telemetry
+
+        tm = telemetry.get()
+        if tm.enabled:
+            tm.meta("xla/persistent_cache_disabled", mode=mode,
+                    resuming=bool(resuming), previous_dir=str(previous))
+        logger.warning(
+            "persistent compile cache DISABLED (%s, resuming=%s): "
+            "executables deserialized from the cache are flaky on "
+            "resume (NaN/SIGSEGV — PR-7 bisect); compiles run cold. "
+            "Set xla_obs.persistent_cache: on to override.",
+            mode, resuming)
+    return True
+
+
 # ------------------------------------------------------------ fingerprints
 
 

@@ -215,10 +215,26 @@ class BaseTrainer:
         rules, optimizer/EMA trees cross-replica sharded over 'data',
         everything committed BEFORE the first step so the compiled
         programs see their final layout from call one — no
-        ``sharding_commit`` re-specialization, ``xla/recompiles`` 0."""
-        if not self.partition.active:
+        ``sharding_commit`` re-specialization, ``xla/recompiles`` 0.
+
+        Multi-process without a partition plan (ISSUE 8): the state
+        commits REPLICATED over the pod-global mesh. Leaving it on
+        per-host local devices (the old behavior) silently compiled N
+        independent single-host programs — each host trained its own
+        replica with no gradient all-reduce at all. Committing globally
+        makes the jitted step one SPMD program over every host's
+        devices, with XLA inserting the cross-process collectives."""
+        if self.partition.active:
+            state, self._state_shardings = self.partition.place_state(
+                state)
             return state
-        state, self._state_shardings = self.partition.place_state(state)
+        if jax.process_count() > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from imaginaire_tpu.parallel.mesh import get_mesh
+
+            return jax.device_put(state,
+                                  NamedSharding(get_mesh(), P()))
         return state
 
     def _constrain_state(self, state):
@@ -837,15 +853,20 @@ class BaseTrainer:
         print(f"Save checkpoint to {path}")
         return path
 
-    def load_checkpoint(self, checkpoint_path=None, resume=None):
+    def load_checkpoint(self, checkpoint_path=None, resume=None,
+                        fallback=False):
         """(ref: base.py:210-265): explicit path = weights-only unless
         resume=True; pointer-file discovery = resume.
 
         The discovery path verifies checksums and falls back: a corrupt
         / truncated pointed checkpoint is quarantined and the newest
         verifiable one restores instead (``ckpt_lib.load_latest_verified``).
-        An explicit path never falls back — the caller asked for that
-        exact checkpoint, so corruption raises."""
+        An explicit path never falls back by default — the caller asked
+        for that exact checkpoint, so corruption raises; serving entry
+        points (inference.py) pass ``fallback=True`` to quarantine the
+        bad checkpoint and restore the newest verifiable sibling
+        instead (ISSUE 8: serving must never deserialize a checkpoint
+        training would refuse)."""
         from imaginaire_tpu import resilience
 
         logdir = cfg_get(self.cfg, "logdir", ".")
@@ -859,6 +880,11 @@ class BaseTrainer:
             payload, checkpoint_path, fallbacks = \
                 ckpt_lib.load_latest_verified(logdir, target=target,
                                               verify=verify)
+            # Pod resume agreement (ISSUE 8): every host verified its
+            # own candidate above; the cluster restores ONE checkpoint
+            # (min over verified) or a host that disagreed follows it.
+            payload, checkpoint_path = self._consensus_restore(
+                payload, checkpoint_path, logdir, target, verify)
             if payload is None:
                 print("No checkpoint found.")
                 return False
@@ -868,9 +894,40 @@ class BaseTrainer:
                       f"checkpoint(s)")
             resume = True if resume is None else resume
         else:
-            payload = ckpt_lib.load_checkpoint(checkpoint_path,
-                                               target=target,
-                                               verify=verify)
+            try:
+                payload = ckpt_lib.load_checkpoint(checkpoint_path,
+                                                   target=target,
+                                                   verify=verify)
+            except Exception as e:  # noqa: BLE001 — corrupt/truncated
+                if not fallback:
+                    raise
+                # serving fallback (ISSUE 8 satellite): quarantine the
+                # named checkpoint and restore the newest one in its
+                # directory that training itself would accept — a
+                # server must never deserialize bytes the training
+                # integrity layer refuses
+                from imaginaire_tpu.resilience import (
+                    quarantine_checkpoint,
+                )
+
+                print(f"WARNING: checkpoint {checkpoint_path} failed "
+                      f"to restore ({type(e).__name__}: {str(e)[:200]});"
+                      f" falling back to the newest verifiable "
+                      f"checkpoint in its directory")
+                quarantine_checkpoint(checkpoint_path,
+                                      reason=f"serving restore failed: "
+                                             f"{type(e).__name__}")
+                ckpt_dir = os.path.dirname(
+                    os.path.abspath(str(checkpoint_path)))
+                payload, checkpoint_path, fallbacks = \
+                    ckpt_lib.load_latest_verified(ckpt_dir,
+                                                  target=target,
+                                                  verify=verify)
+                if payload is None:
+                    raise RuntimeError(
+                        f"no verifiable fallback checkpoint in "
+                        f"{ckpt_dir} (no pointer file)") from e
+                print(f"Serving fallback: restored {checkpoint_path}")
         restored = payload["state"]
         if resume:
             self.state = restored
@@ -897,6 +954,59 @@ class BaseTrainer:
                 self._ema_batch_stats = pickle.load(f)
         print(f"Done with loading the checkpoint (resume={bool(resume)}).")
         return True
+
+    def _consensus_restore(self, payload, checkpoint_path, logdir,
+                           target, verify):
+        """Pod resume agreement (ISSUE 8): every host publishes the
+        iteration of the newest checkpoint IT verified; the cluster
+        restores the min over verified. A host whose local candidate
+        was newer (its copy of the consensus target verified, a peer's
+        did not) — or whose own verification failed where a peer's
+        succeeded — follows the consensus instead of silently training
+        from different weights than the rest of the pod. A host that
+        cannot restore the agreed checkpoint at all raises
+        ``ClusterDesyncError`` (diverging silently is the one
+        unacceptable outcome; ``resilience/resume_divergence`` stays
+        fatal in the health gate). Single-process: identity."""
+        from imaginaire_tpu.resilience import cluster
+
+        if not cluster.is_active():
+            return payload, checkpoint_path
+        it_local = (ckpt_lib.parse_checkpoint_name(checkpoint_path)[1]
+                    if checkpoint_path else -1)
+        name_local = (os.path.basename(str(checkpoint_path))
+                      if checkpoint_path else None)
+        consensus, votes = cluster.agree_min("resume", it_local,
+                                             extra=name_local)
+        if consensus < 0 or it_local == consensus:
+            # nobody has a checkpoint, or this host already holds the
+            # agreed one
+            return payload, checkpoint_path
+        name = next((x for v, x in votes.values()
+                     if v == consensus and x), None)
+        tm = telemetry.get()
+        if tm.enabled:
+            tm.meta("resilience/consensus_resume",
+                    local_iteration=it_local, consensus=consensus,
+                    consensus_checkpoint=name,
+                    votes={str(p): v for p, (v, _) in votes.items()})
+            tm.counter("resilience/consensus_overrides", 1)
+        print(f"Pod resume consensus: this host verified iteration "
+              f"{it_local if it_local >= 0 else '<none>'} but the "
+              f"cluster agreed on {consensus} ({name}); following the "
+              f"consensus")
+        path = os.path.join(logdir, name)
+        try:
+            payload = ckpt_lib.load_checkpoint(path, target=target,
+                                               verify=verify)
+        except Exception as e:  # noqa: BLE001
+            raise cluster.ClusterDesyncError(
+                f"process {cluster.process_index()} cannot restore the "
+                f"cluster-agreed checkpoint {path} "
+                f"({type(e).__name__}: {str(e)[:300]}); refusing to "
+                f"resume divergent — restart the pod after repairing "
+                f"the checkpoint directory") from e
+        return payload, path
 
     def _restore_runstate(self, checkpoint_path):
         """Replay the checkpoint's host-side run state (runstate
@@ -1008,7 +1118,11 @@ class BaseTrainer:
                                  checkpoint=str(checkpoint_path))
             print(f"Resharding restored checkpoint: saved partition "
                   f"{saved} -> current {current}")
-        if self.partition.active:
+        if self.partition.active or jax.process_count() > 1:
+            # the pod resume re-commits under the global mesh
+            # (replicated when no plan is active) — the same placement
+            # init_state produced, so the warm step programs keep their
+            # fingerprint
             self.state = self._place_state(self.state)
         else:
             # the restored leaves are host numpy (load_checkpoint is

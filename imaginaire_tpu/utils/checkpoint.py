@@ -132,6 +132,14 @@ def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None,
     # otherwise race the existence check below (orbax also serializes
     # saves internally, so this costs nothing extra)
     wait_for_pending_checkpoint()
+    # Multi-process entry barrier (ISSUE 8): orbax's collective save
+    # blocks untimed on every host — a peer that never arrives (dead or
+    # stalled) used to hang the pod here forever. The timed rendezvous
+    # raises ClusterDesyncError NAMING the absent process instead; once
+    # everyone has passed it, the collective itself is entered together.
+    from imaginaire_tpu.resilience import cluster
+
+    cluster.timed_barrier("ckpt_enter", tag=name)
 
     def _write_pointer():
         if is_master():
@@ -164,6 +172,15 @@ def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None,
                 logging.getLogger(__name__).warning(
                     "checkpoint file-digest pass failed: %s", e)
         _write_sidecars(path, partition_descriptor, full)
+        # All-host commit barrier BEFORE the pointer moves (ISSUE 8):
+        # the pointer must never name a checkpoint some host has not
+        # finished committing — a restart racing that window would
+        # resume half the pod from the new checkpoint and half from
+        # the old one. Timed, so a host that died mid-commit surfaces
+        # as a named ClusterDesyncError, not a wedged pointer thread.
+        from imaginaire_tpu.resilience import cluster
+
+        cluster.timed_barrier("ckpt_commit", tag=name)
         _write_pointer()
         gc_checkpoints(logdir, max_to_keep, protect=(path,))
         chaos.get().maybe_corrupt_checkpoint(path, iteration)
@@ -450,7 +467,7 @@ def gc_checkpoints(logdir, max_to_keep, protect=()):
     import logging
     import shutil
 
-    from imaginaire_tpu.resilience.integrity import SIDECAR_SUFFIXES
+    from imaginaire_tpu.resilience.integrity import sidecar_files
 
     deleted = []
     for path in doomed:
@@ -460,13 +477,11 @@ def gc_checkpoints(logdir, max_to_keep, protect=()):
             logging.getLogger(__name__).warning(
                 "checkpoint GC failed to delete %s: %s", path, e)
             continue
-        for suffix in SIDECAR_SUFFIXES:
-            sidecar = path + suffix
-            if os.path.exists(sidecar):
-                try:
-                    os.remove(sidecar)
-                except OSError:
-                    pass
+        for sidecar in sidecar_files(path):
+            try:
+                os.remove(sidecar)
+            except OSError:
+                pass
         deleted.append(path)
     if deleted:
         tm = telemetry.get()
@@ -484,6 +499,25 @@ def gc_checkpoints(logdir, max_to_keep, protect=()):
 
 
 # -------------------------------------------------------------- restore
+
+
+def _host_template(target):
+    """A host-numpy zeros pytree with ``target``'s structure: what
+    orbax needs from ``item`` is the tree structure (optimizer
+    namedtuples survive the round-trip) and per-leaf dtypes/shapes —
+    not the values. Building zeros instead of ``jax.device_get(target)``
+    skips a full state materialization per restore and works when the
+    live state is a non-addressable pod-sharded tree (ISSUE 8), where
+    ``device_get`` raises."""
+    import jax
+    import numpy as np
+
+    def leaf(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return np.zeros(x.shape, x.dtype)
+        return x
+
+    return jax.tree_util.tree_map(leaf, target)
 
 
 def load_checkpoint(path, target=None, verify=True):
@@ -513,9 +547,36 @@ def load_checkpoint(path, target=None, verify=True):
     with telemetry.span("ckpt_load"), ocp.PyTreeCheckpointer() as ckpt:
         if target is not None:
             payload = ckpt.restore(os.path.abspath(path),
-                                   item=jax.device_get(target))
+                                   item=_host_template(target))
         else:
-            payload = ckpt.restore(os.path.abspath(path))
+            # no target: force every array leaf to restore as host
+            # numpy (ISSUE 8). Without restore args orbax replays the
+            # SAVED shardings — a checkpoint written by an N-process
+            # pod then refuses to restore in any other topology (the
+            # mesh in the sharding file names devices this process
+            # does not have). numpy restore keeps the documented
+            # contract: restores are layout-agnostic, callers commit
+            # under their own shardings.
+            import numpy as np
+
+            meta = ckpt.metadata(os.path.abspath(path))
+            restore_args = jax.tree_util.tree_map(
+                lambda m: (ocp.RestoreArgs(restore_type=np.ndarray)
+                           if hasattr(m, "shape") else ocp.RestoreArgs()),
+                meta)
+            payload = ckpt.restore(os.path.abspath(path),
+                                   restore_args=restore_args)
+
+            def _true_shape(v, m):
+                # orbax hands scalar zarr arrays back as shape (1,)
+                # ndarrays on the numpy restore path; the metadata
+                # remembers the saved shape
+                if hasattr(m, "shape") and hasattr(v, "shape") \
+                        and tuple(v.shape) != tuple(m.shape):
+                    return np.asarray(v).reshape(tuple(m.shape))
+                return v
+
+            payload = jax.tree_util.tree_map(_true_shape, payload, meta)
     if verify:
         from imaginaire_tpu.resilience.integrity import verify_tree
 

@@ -16,6 +16,7 @@ from imaginaire_tpu.data import get_test_dataloader
 from imaginaire_tpu.parallel.mesh import (
     honor_platform_env,
     master_only_print as print,  # noqa: A001
+    maybe_init_distributed_from_env,
     mesh_from_config,
     set_mesh,
 )
@@ -37,6 +38,7 @@ def parse_args():
 
 def main():
     honor_platform_env()
+    maybe_init_distributed_from_env()
     args = parse_args()
     cfg = Config(args.config)
     # cfg.parallel.mesh_shape wins over the legacy runtime.mesh block
@@ -58,7 +60,15 @@ def main():
     sample = next(iter(test_loader))
     sample = trainer.start_of_iteration(sample, 0)
     trainer.init_state(jax.random.PRNGKey(args.seed), sample)
-    loaded = trainer.load_checkpoint(args.checkpoint or None)
+    # serving restore rides the verified path end to end (ISSUE 8
+    # satellite): discovery already quarantines + falls back to the
+    # last-good checkpoint; an explicit --checkpoint that fails
+    # integrity is quarantined and the newest verifiable sibling
+    # restores instead — a server must never deserialize bytes the
+    # training integrity layer refuses (corrupt compressed chunks fed
+    # to the native decoder are a heap hazard, not a wrong pixel).
+    loaded = trainer.load_checkpoint(args.checkpoint or None,
+                                     fallback=bool(args.checkpoint))
     if not loaded:
         print("WARNING: no checkpoint found; running with fresh weights.")
 

@@ -15,10 +15,18 @@ Checks (each can fail the gate):
   counters (guards against a config that silently disabled diagnostics
   — a green gate over a blind run is worse than a red one).
 
+Multi-host pods (ISSUE 8): every process writes its own
+``telemetry.jsonl.p<i>`` — ``--hosts`` aggregates ALL per-process files
+(plus a plain ``telemetry.jsonl`` if present) and fails the gate when
+ANY process reports trouble: one host's non-finite step, checkpoint
+fallback, exhausted retry budget, or cluster desync is a pod-level
+failure even when the other N-1 logs look clean.
+
 Usage:
     python scripts/check_run_health.py logs/<run>            # dir works
     python scripts/check_run_health.py logs/<run>/telemetry.jsonl
     python scripts/check_run_health.py <path> --require-health --json
+    python scripts/check_run_health.py logs/<run> --hosts    # pod gate
 """
 
 from __future__ import annotations
@@ -110,11 +118,41 @@ def check_health(summary, require_health=False, max_dg_breaches=0,
             f"{max_dg_breaches})")
     if summary.get("hangs"):
         failures.append(f"{len(summary['hangs'])} watchdog hang dump(s)")
+    if res.get("cluster_desyncs"):
+        failures.append(
+            f"{res['cluster_desyncs']} cluster desync(s): "
+            + "; ".join(
+                f"barrier {e.get('barrier')} absent {e.get('absent')}"
+                for e in res.get("desync_events", [])[:3]))
     if require_health and not health.get("has_health_counters"):
         failures.append(
             "no health/* counters in the run (diagnostics disabled or "
             "the run died before the first audit cadence)")
     return failures
+
+
+def host_files(path):
+    """The per-process telemetry files of a run dir (or the single file
+    the path names): ``telemetry.jsonl`` plus every
+    ``telemetry.jsonl.p<i>``, sorted by process index."""
+    import glob as _glob
+    import re as _re
+
+    if os.path.isfile(path):
+        base, dirname = os.path.basename(path), os.path.dirname(path)
+        m = _re.match(r"(telemetry\.jsonl)(\.p\d+)?$", base)
+        root = os.path.join(dirname, m.group(1)) if m else path
+    else:
+        root = os.path.join(path, "telemetry.jsonl")
+    out = []
+    if os.path.exists(root):
+        out.append((None, root))
+    for f in _glob.glob(root + ".p*"):
+        m = _re.search(r"\.p(\d+)$", f)
+        if m:
+            out.append((int(m.group(1)), f))
+    out.sort(key=lambda kv: (-1 if kv[0] is None else kv[0]))
+    return out
 
 
 def main(argv=None):
@@ -139,10 +177,21 @@ def main(argv=None):
                          "(resilience/ckpt_fallbacks; default 0 — "
                          "chaos legs that corrupt on purpose pass 1). "
                          "Resume-divergence events always fail.")
+    ap.add_argument("--hosts", action="store_true",
+                    help="aggregate every per-process telemetry file "
+                         "(telemetry.jsonl + telemetry.jsonl.p*) of a "
+                         "pod run; the gate fails when ANY process "
+                         "fails it")
+    ap.add_argument("--expect-hosts", type=int, default=None,
+                    help="with --hosts: fail unless at least this many "
+                         "per-process files exist (a silently missing "
+                         "host's log is itself a failure)")
     ap.add_argument("--json", action="store_true",
                     help="print the verdict as JSON")
     args = ap.parse_args(argv)
     path = args.path
+    if args.hosts:
+        return _main_hosts(args)
     if os.path.isdir(path):
         path = os.path.join(path, "telemetry.jsonl")
     if not os.path.exists(path):
@@ -193,6 +242,48 @@ def main(argv=None):
               f"(health counters: "
               f"{'yes' if health.get('has_health_counters') else 'no'})")
     return 1 if failures else 0
+
+
+def _main_hosts(args):
+    """``--hosts``: gate every per-process telemetry file; any process
+    failing fails the pod."""
+    files = host_files(args.path)
+    if not files:
+        print(f"check_run_health: no telemetry files under {args.path}",
+              file=sys.stderr)
+        return 2
+    if args.expect_hosts is not None and len(files) < args.expect_hosts:
+        print(f"check_run_health: FAIL — only {len(files)} per-process "
+              f"telemetry file(s) found, expected >= {args.expect_hosts}"
+              f" (a host died before writing, or its log is missing)")
+        return 1
+    verdicts = {}
+    any_fail = False
+    for proc, fpath in files:
+        label = "p?" if proc is None else f"p{proc}"
+        summary = summarize(load_events(fpath))
+        failures = check_health(summary,
+                                require_health=args.require_health,
+                                max_dg_breaches=args.max_dg_breaches,
+                                max_recompiles=args.max_recompiles,
+                                mem_budget_frac=args.mem_budget_frac,
+                                max_fallbacks=args.max_fallbacks)
+        verdicts[label] = {"path": fpath, "healthy": not failures,
+                           "failures": failures}
+        any_fail = any_fail or bool(failures)
+        if not args.json:
+            if failures:
+                for failure in failures:
+                    print(f"check_run_health[{label}]: FAIL — {failure}")
+            else:
+                print(f"check_run_health[{label}]: OK — {fpath}")
+    if args.json:
+        print(json.dumps({"hosts": verdicts, "healthy": not any_fail},
+                         indent=1, default=str))
+    elif not any_fail:
+        print(f"check_run_health: OK — all {len(files)} process file(s) "
+              f"healthy")
+    return 1 if any_fail else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Localhost CPU pod harness (ISSUE 8): spawn N ``jax.distributed``
+processes of an imaginaire-tpu entry point on this machine.
+
+This is the zero-hardware proof of the multi-process stack: each child
+gets its own virtual CPU device(s) and joins one coordination service
+on 127.0.0.1, so the pod runs REAL cross-process collectives (gloo),
+real collective orbax checkpointing, real timed barriers — everything a
+TPU pod runs except the ICI. The dryrun ``spade_pod`` leg and the
+chaos/resilience tests drive it; operators can use it to rehearse pod
+procedures (kill/restart drills, consensus resume) before burning pod
+hours.
+
+Usage:
+    python scripts/launch_local_pod.py --num-processes 2 -- \
+        train.py --config cfg.yaml --logdir logs/pod --seed 0
+
+Everything after ``--`` is the per-process command line (executed with
+this interpreter). The harness:
+  - picks a free coordinator port and exports the ``IMAGINAIRE_DIST_*``
+    env contract (``parallel/mesh.maybe_init_distributed_from_env``);
+  - forces ``JAX_PLATFORMS=cpu`` and one virtual CPU device per process
+    (``--devices-per-process`` to change);
+  - relays each child's output under a ``[p<i>]`` prefix, live;
+  - enforces ``--timeout`` by killing the whole pod (exit 124) — a
+    hung pod must fail loudly, hangs are the failure mode under test;
+  - exits 0 only when EVERY process exits ``--expect-exit`` (default
+    0). ``--expect-exit 75`` asserts a coordinated preemption drain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+
+def free_port():
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description="spawn an N-process localhost CPU pod")
+    ap.add_argument("--num-processes", type=int, default=2)
+    ap.add_argument("--devices-per-process", type=int, default=1,
+                    help="virtual CPU devices per process (the pod "
+                         "mesh has N*this devices on 'data')")
+    ap.add_argument("--timeout", type=float, default=1800.0,
+                    help="seconds before the whole pod is killed "
+                         "(exit 124) — a hung pod must fail loudly")
+    ap.add_argument("--expect-exit", type=int, default=0,
+                    help="required exit code of EVERY process (75 for "
+                         "a coordinated preemption drain)")
+    ap.add_argument("--expect-failure", action="store_true",
+                    help="success = every process exited NONZERO "
+                         "(desync drills: the exact code depends on "
+                         "whether the coordination service aborted the "
+                         "process before its traceback exit)")
+    ap.add_argument("--coordinator-port", type=int, default=None)
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="entry point + args, after '--' (e.g. "
+                         "train.py --config ...)")
+    args = ap.parse_args(argv)
+    cmd = list(args.command)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given (everything after '--')")
+    args.command = cmd
+    return args
+
+
+def launch_pod(command, num_processes=2, devices_per_process=1,
+               timeout=1800.0, coordinator_port=None, extra_env=None,
+               prefix_output=True, cwd=None):
+    """Spawn the pod; returns ``(exit_codes, wall_s)`` with one exit
+    code per process (None replaced by -9 when the timeout killed it).
+    """
+    port = coordinator_port or free_port()
+    here = cwd or os.getcwd()
+    procs = []
+    readers = []
+    write_lock = threading.Lock()
+
+    def relay(tag, pipe):
+        for line in pipe:
+            with write_lock:
+                sys.stdout.write(f"[{tag}] {line}")
+                sys.stdout.flush()
+        pipe.close()
+
+    for idx in range(num_processes):
+        env = dict(os.environ, **(extra_env or {}))
+        env["IMAGINAIRE_DIST_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["IMAGINAIRE_DIST_NUM_PROCESSES"] = str(num_processes)
+        env["IMAGINAIRE_DIST_PROCESS_ID"] = str(idx)
+        env["JAX_PLATFORMS"] = "cpu"
+        # --devices-per-process always wins: an inherited device-count
+        # flag (e.g. the dryrun parent's 8-device virtual mesh) would
+        # silently change the pod's topology — and a per-host batch
+        # that no longer divides the per-host device count corrupts
+        # the global batch assembly
+        import re
+
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       "", env.get("XLA_FLAGS", "")).strip()
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+                    f"{devices_per_process}").strip()
+        proc = subprocess.Popen(
+            [sys.executable, "-u"] + list(command), cwd=here, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        procs.append(proc)
+        if prefix_output:
+            reader = threading.Thread(target=relay,
+                                      args=(f"p{idx}", proc.stdout),
+                                      daemon=True)
+            reader.start()
+            readers.append(reader)
+
+    t0 = time.monotonic()
+    deadline = t0 + timeout
+    codes = [None] * num_processes
+    while time.monotonic() < deadline and any(c is None for c in codes):
+        for i, proc in enumerate(procs):
+            if codes[i] is None:
+                codes[i] = proc.poll()
+        time.sleep(0.2)
+    timed_out = any(c is None for c in codes)
+    if timed_out:
+        sys.stderr.write(
+            f"launch_local_pod: TIMEOUT after {timeout:.0f}s — killing "
+            f"{sum(c is None for c in codes)} hung process(es) "
+            f"(exit codes so far: {codes})\n")
+        for i, proc in enumerate(procs):
+            if codes[i] is None:
+                proc.kill()
+        for i, proc in enumerate(procs):
+            if codes[i] is None:
+                proc.wait()
+                codes[i] = -9
+    for reader in readers:
+        reader.join(timeout=10)
+    return codes, time.monotonic() - t0, timed_out
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    codes, wall, timed_out = launch_pod(
+        args.command, num_processes=args.num_processes,
+        devices_per_process=args.devices_per_process,
+        timeout=args.timeout, coordinator_port=args.coordinator_port)
+    want = ("nonzero" if args.expect_failure
+            else str(args.expect_exit))
+    print(f"launch_local_pod: exit codes {codes} in {wall:.1f}s "
+          f"(expected {want} from all {args.num_processes})")
+    if timed_out:
+        return 124
+    if args.expect_failure:
+        return 0 if all(c != 0 for c in codes) else 1
+    return 0 if all(c == args.expect_exit for c in codes) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,10 +13,16 @@ import sys
 import jax
 
 from imaginaire_tpu import resilience, telemetry
-from imaginaire_tpu.resilience import chaos
+from imaginaire_tpu.resilience import chaos, cluster
 from imaginaire_tpu.config import Config, cfg_get
 from imaginaire_tpu.data import get_train_and_val_dataloader
-from imaginaire_tpu.parallel.mesh import mesh_from_config, master_only_print as print, set_mesh, honor_platform_env
+from imaginaire_tpu.parallel.mesh import (
+    honor_platform_env,
+    master_only_print as print,  # noqa: A001
+    maybe_init_distributed_from_env,
+    mesh_from_config,
+    set_mesh,
+)
 from imaginaire_tpu.registry import resolve
 from imaginaire_tpu.utils.logging_utils import init_logging, make_logging_dir
 
@@ -43,6 +49,11 @@ def parse_args():
 
 def main():
     honor_platform_env()
+    # multi-process pods (ISSUE 8): IMAGINAIRE_DIST_* env vars (set by
+    # scripts/launch_local_pod.py or a real pod launcher) initialize
+    # jax.distributed BEFORE any backend exists — every jax.devices()
+    # below then spans the whole pod
+    maybe_init_distributed_from_env()
     args = parse_args()
     cfg = Config(args.config)
     if args.max_iter is not None:
@@ -69,11 +80,27 @@ def main():
     # the configured sinks (<logdir>/telemetry.jsonl by default); the
     # watchdog/trace knobs ride the same cfg section
     tm = telemetry.configure(cfg, logdir=logdir)
+    # persistent-compile-cache guard (ISSUE 8 satellite): a warm-cache
+    # RESUME rides the known-bad executable-deserialize path (flaky
+    # NaN/SIGSEGV, PR-7 bisect) — off_on_resume (default) disables the
+    # cache exactly when a checkpoint will be restored. Must run before
+    # the first compile.
+    from imaginaire_tpu.telemetry import xla_obs
+    from imaginaire_tpu.utils import checkpoint as ckpt_lib
+
+    resuming = bool(args.checkpoint) \
+        or ckpt_lib.latest_checkpoint_path(logdir) is not None
+    xla_obs.apply_persistent_cache_policy(cfg, resuming=resuming)
     # fault-tolerance layer (resilience/): retry policy + chaos
-    # injection singleton, and the SIGTERM preemption guard that drains
-    # the in-flight step into an emergency checkpoint (ISSUE 7)
-    resilience.configure(cfg)
+    # injection singleton, the SIGTERM preemption guard that drains the
+    # in-flight step into an emergency checkpoint (ISSUE 7), and the
+    # cluster coordination policy — timed barriers, per-step preemption
+    # votes, cross-host heartbeats (ISSUE 8)
+    rsettings = resilience.configure(cfg)
     guard = resilience.install_preemption_guard(cfg)
+    cluster.start_heartbeat(cfg)
+    sync_every = rsettings["cluster"]["sync_every_n_steps"] \
+        if cluster.is_active() else 0
 
     train_loader, val_loader = get_train_and_val_dataloader(cfg, seed=args.seed)
     trainer_cls = resolve(cfg.trainer.type, "Trainer")
@@ -156,9 +183,34 @@ def main():
             current_iteration += 1
             if prefetching:
                 trainer.write_data_meters(feed.drain_stats())
+            # distributed chaos (ISSUE 8): stall-one-of-N freezes THIS
+            # process here — after the step's collectives dispatched,
+            # before any cluster rendezvous — so the surviving hosts'
+            # next timed barrier (preemption vote or checkpoint entry)
+            # names it instead of hanging
+            chaos.get().maybe_stall(current_iteration)
             trainer.end_of_iteration(data, epoch, current_iteration)
             chaos.get().maybe_sigterm(current_iteration)
-            if guard is not None and guard.triggered:
+            chaos.get().maybe_kill(current_iteration)
+            drain = guard is not None and guard.triggered
+            if sync_every:
+                # coordinated preemption (ISSUE 8): a SIGTERM lands on
+                # ONE host but the emergency save is collective — the
+                # per-step vote makes every host observe the same OR at
+                # the same iteration, so the pod drains together
+                # instead of deadlocking (one host in the save barrier,
+                # the rest in the next step's psum). Between vote
+                # iterations a locally-triggered guard DEFERS: draining
+                # solo is the deadlock this machinery exists to avoid.
+                if current_iteration % sync_every == 0:
+                    flagged = cluster.coordinate_preemption(
+                        current_iteration, drain)
+                    if flagged and not drain and guard is not None:
+                        guard.trigger_remote()
+                    drain = drain or (flagged and guard is not None)
+                else:
+                    drain = False
+            if drain:
                 # preemption drain: the dispatched step already landed
                 # (save blocks on the live arrays), so commit an
                 # emergency checkpoint + run state and exit resumable
