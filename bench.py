@@ -46,12 +46,15 @@ def _bench_telemetry():
                                flush_every_n_steps=0, mfu=False)
 
 
-def _leg_summary(tm, xla_mark=None):
+def _leg_summary(tm, xla_mark=None, trainer=None):
     """Slim window_summary for the bench JSON sidecars. With an
     ``xla_mark`` (a ledger snapshot from the leg's start), the summary
     also carries the leg's compile cost, recompile count, and the peak
     HBM watermark (ISSUE 5: every bench leg answers 'what did compiles
-    cost and did anything re-specialize')."""
+    cost and did anything re-specialize'). With a ``trainer``, the
+    summary records the precision/remat configuration the leg actually
+    ran under (ISSUE 10: a bench number is meaningless without the
+    compute dtype + checkpointing policy that produced it)."""
     s = tm.window_summary()
     keep = ("duration_s", "steps", "step_ms_p50", "step_ms_p99",
             "data_wait_share_pct", "imgs_per_sec")
@@ -60,8 +63,36 @@ def _leg_summary(tm, xla_mark=None):
                              for name, row in s.get("phases", {}).items()}
     if xla_mark is not None:
         out["xla"] = _xla_leg(xla_mark)
+    if trainer is not None:
+        out["precision"] = _precision_leg(trainer)
     out["resilience"] = _resilience_leg()
     return out
+
+
+def _precision_leg(trainer):
+    """{compute_dtype, remat_policy, temp_bytes} for one bench leg
+    (ISSUE 10). temp_bytes is the worst per-executable XLA temp
+    allocation the compile ledger saw (gen_step/dis_step and friends) —
+    None on backends that don't expose memory_analysis (CPU)."""
+    import jax.numpy as jnp
+
+    from imaginaire_tpu.config import cfg_get
+    from imaginaire_tpu.telemetry import xla_obs
+
+    temp = None
+    try:
+        for mem in xla_obs.ledger().label_memory.values():
+            t = mem.get("temp_bytes")
+            if t is not None:
+                temp = max(int(t), temp or 0)
+    except Exception:  # noqa: BLE001 — bench accounting is best-effort
+        pass
+    return {
+        "compute_dtype": str(jnp.dtype(trainer.compute_dtype).name),
+        "remat_policy": str(cfg_get(getattr(trainer.cfg, "gen", None),
+                                    "remat", "none")),
+        "temp_bytes": temp,
+    }
 
 
 def _resilience_leg():
@@ -454,7 +485,7 @@ def run_vid2vid(seq_len=4):
                 tm.step_complete(i, items=bs * seq_len)
             sync()
             dt = time.time() - t0
-            leg_telemetry = _leg_summary(tm, xla_mark)
+            leg_telemetry = _leg_summary(tm, xla_mark, trainer=trainer)
             frames_per_sec = bs * seq_len * iters / dt
             # same recipe with the whole-rollout scan tail
             # (trainer.rollout_scan) for the head-to-head record;
@@ -904,7 +935,8 @@ def _pipeline_ab(cfg, iters=10):
             tm.step_complete(i, items=bs)
         float(jnp.sum(jax.tree_util.tree_leaves(
             trainer.state["vars_G"]["params"])[0]))
-        return bs * iters / (time.time() - t0), _leg_summary(tm)
+        return (bs * iters / (time.time() - t0),
+                _leg_summary(tm, trainer=trainer))
 
     # leg 1 — synchronous pipeline feed (device_prefetch off: raw loader
     # batches through start_of_iteration's blocking to_device)
@@ -935,7 +967,7 @@ def _pipeline_ab(cfg, iters=10):
     t0 = time.time()
     steps(data, iters)
     synth_rate = bs * iters / (time.time() - t0)
-    synth_tm = _leg_summary(tm)
+    synth_tm = _leg_summary(tm, trainer=trainer)
 
     parallel_leg = _parallel_leg(trainer)
     trainer.state = None

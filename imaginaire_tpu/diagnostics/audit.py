@@ -24,8 +24,15 @@ import optax
 
 
 def tree_norm(tree):
-    """Global L2 norm of a pytree (optax.global_norm, fp32)."""
-    return optax.global_norm(tree).astype(jnp.float32)
+    """Global L2 norm of a pytree, accumulated in fp32. Leaves are
+    upcast BEFORE the sum-of-squares — casting the finished norm would
+    let a bf16 tree accumulate (and overflow/round) in bf16 first."""
+    tree32 = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x).astype(jnp.float32), tree)
+    norm = optax.global_norm(tree32)
+    assert norm.dtype == jnp.float32, (
+        f"health-audit norms must stay float32, got {norm.dtype}")
+    return norm
 
 
 def finite_flag(total_loss, grad_norm):

@@ -68,17 +68,18 @@ def _eval_losses(trainer, kind, data, rng, params=None):
     the raw loss dict (device scalars)."""
     st = trainer.state
     cd = trainer._to_compute_dtype
+    cv = trainer._cast_net_vars  # params-only: fp32 islands keep dtype
     if kind == "D":
         vars_D = dict(st["vars_D"],
                       params=cd(params if params is not None
                                 else st["vars_D"]["params"]))
-        out = trainer.dis_forward(cd(st["vars_G"]), vars_D,
+        out = trainer.dis_forward(cv(st["vars_G"]), vars_D,
                                   st["loss_params"], cd(data), rng)
     else:
         vars_G = dict(st["vars_G"],
                       params=cd(params if params is not None
                                 else st["vars_G"]["params"]))
-        out = trainer.gen_forward(vars_G, cd(st.get("vars_D")),
+        out = trainer.gen_forward(vars_G, cv(st.get("vars_D")),
                                   st["loss_params"], cd(data), rng)
     return out[0]  # (losses, new_mut[, extra]) across trainer families
 

@@ -54,6 +54,8 @@ class InstanceNorm(nn.Module):
         x32 = x.astype(jnp.float32)
         mean = jnp.mean(x32, axis=axes, keepdims=True)
         var = jnp.var(x32, axis=axes, keepdims=True)
+        assert mean.dtype == jnp.float32, (
+            f"InstanceNorm statistics must stay float32, got {mean.dtype}")
         y = ((x32 - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))).astype(x.dtype)
         if self.affine:
             c = x.shape[-1]
@@ -107,6 +109,8 @@ class LayerNorm2d(nn.Module):
         x32 = x.astype(jnp.float32)
         mean = jnp.mean(x32, axis=axes, keepdims=True)
         std = jnp.sqrt(jnp.var(x32, axis=axes, keepdims=True) + self.eps)
+        assert mean.dtype == jnp.float32, (
+            f"LayerNorm2d statistics must stay float32, got {mean.dtype}")
         y = ((x32 - mean) / std).astype(x.dtype)
         if self.affine:
             c = x.shape[-1]

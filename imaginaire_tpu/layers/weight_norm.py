@@ -26,15 +26,21 @@ def power_iteration(w_mat, u, n_steps=1, eps=1e-12):
     """One (or more) power-iteration steps. w_mat: (out, rest), u: (out,).
 
     Returns (sigma, new_u). Gradients do not flow through u/v (matching
-    torch.nn.utils.spectral_norm's no_grad update)."""
-    w_ng = lax.stop_gradient(w_mat)
+    torch.nn.utils.spectral_norm's no_grad update). The iteration is an
+    fp32 island: a bf16 compute policy hands in a bf16 w_mat, but the
+    normalize/matvec chain runs — and sigma and u come back — in fp32
+    (sigma is a ratio of near-equal quantities; bf16's 8 mantissa bits
+    visibly bias it, and a drifting low-precision u never converges)."""
+    assert u.dtype == jnp.float32, (
+        f"spectral-norm u must stay float32, got {u.dtype}")
+    w_ng = lax.stop_gradient(w_mat).astype(jnp.float32)
     v = None
     for _ in range(n_steps):
         v = _l2_normalize(w_ng.T @ u, eps)
         u = _l2_normalize(w_ng @ v, eps)
     u = lax.stop_gradient(u)
     v = lax.stop_gradient(v)
-    sigma = jnp.einsum("o,or,r->", u, w_mat, v)
+    sigma = jnp.einsum("o,or,r->", u, w_mat.astype(jnp.float32), v)
     return sigma, u
 
 
@@ -45,7 +51,8 @@ def estimate_sigma(kernel, u, eps=1e-12):
     exclusive job of ``spectral_normalize``). Same (out, rest) matrix
     view as ``power_iteration`` so tracked sigmas agree with the ones
     the normalization divides by."""
-    w_mat = kernel.reshape(-1, kernel.shape[-1]).T  # (out, rest)
+    w_mat = kernel.reshape(-1, kernel.shape[-1]).T.astype(jnp.float32)
+    u = u.astype(jnp.float32)
     v = _l2_normalize(w_mat.T @ u, eps)
     return jnp.einsum("o,or,r->", u, w_mat, v)
 
@@ -73,7 +80,10 @@ def spectral_normalize(module, kernel, training, name="u", n_steps=1, eps=1e-12)
     if (training and not module.is_initializing()
             and module.is_mutable_collection("spectral")):
         u_var.value = new_u
-    return kernel / sigma
+    # divide in the kernel's own dtype: sigma is fp32, and `kernel /
+    # sigma` would silently promote a bf16 kernel (and every conv after
+    # it) back to fp32
+    return kernel * (1.0 / sigma).astype(kernel.dtype)
 
 
 def weight_normalize(module, kernel, name="g", eps=1e-12):

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from imaginaire_tpu.layers import Conv2dBlock
+from imaginaire_tpu.optim.remat import remat_block
 
 
 def _upsample2x_bilinear(x):
@@ -35,6 +36,9 @@ class FPSEDiscriminator(nn.Module):
     kernel_size: int = 3
     weight_norm_type: str = "spectral"
     activation_norm_type: str = "none"
+    # named jax.checkpoint policy over the pyramid convs
+    # (optim.remat.POLICIES)
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, images, segmaps, training=False):
@@ -43,22 +47,28 @@ class FPSEDiscriminator(nn.Module):
         pad = int(math.ceil((ks - 1.0) / 2))
 
         def down(ch, name):
-            return Conv2dBlock(ch, kernel_size=ks, stride=2, padding=pad,
-                               weight_norm_type=self.weight_norm_type,
-                               activation_norm_type=self.activation_norm_type,
-                               nonlinearity="leakyrelu", order="CNA", name=name)
+            return remat_block(
+                Conv2dBlock, self.remat, where="dis.remat",
+                out_channels=ch, kernel_size=ks, stride=2, padding=pad,
+                weight_norm_type=self.weight_norm_type,
+                activation_norm_type=self.activation_norm_type,
+                nonlinearity="leakyrelu", order="CNA", name=name)
 
         def lat(ch, name):
-            return Conv2dBlock(ch, kernel_size=1, stride=1,
-                               weight_norm_type=self.weight_norm_type,
-                               activation_norm_type=self.activation_norm_type,
-                               nonlinearity="leakyrelu", order="CNA", name=name)
+            return remat_block(
+                Conv2dBlock, self.remat, where="dis.remat",
+                out_channels=ch, kernel_size=1, stride=1,
+                weight_norm_type=self.weight_norm_type,
+                activation_norm_type=self.activation_norm_type,
+                nonlinearity="leakyrelu", order="CNA", name=name)
 
         def final(ch, name):
-            return Conv2dBlock(ch, kernel_size=ks, stride=1, padding=pad,
-                               weight_norm_type=self.weight_norm_type,
-                               activation_norm_type=self.activation_norm_type,
-                               nonlinearity="leakyrelu", order="CNA", name=name)
+            return remat_block(
+                Conv2dBlock, self.remat, where="dis.remat",
+                out_channels=ch, kernel_size=ks, stride=1, padding=pad,
+                weight_norm_type=self.weight_norm_type,
+                activation_norm_type=self.activation_norm_type,
+                nonlinearity="leakyrelu", order="CNA", name=name)
 
         # bottom-up pathway (ref: fpse.py:61-66)
         feat11 = down(nf, "enc1")(images, training=training)

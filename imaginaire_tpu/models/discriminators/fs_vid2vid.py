@@ -49,6 +49,7 @@ def _make_patch_dis(dis_cfg, name):
         max_num_filters=cfg_get(dis_cfg, "max_num_filters", 512),
         activation_norm_type=cfg_get(dis_cfg, "activation_norm_type", "none"),
         weight_norm_type=cfg_get(dis_cfg, "weight_norm_type", "spectral"),
+        remat=cfg_get(dis_cfg, "remat", "none"),
         name=name)
 
 

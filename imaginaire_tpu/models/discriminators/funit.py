@@ -16,6 +16,7 @@ from flax import linen as nn
 
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.layers import Conv2dBlock, Res2dBlock
+from imaginaire_tpu.optim.remat import remat_block
 
 
 class FUNITResDiscriminator(nn.Module):
@@ -27,6 +28,9 @@ class FUNITResDiscriminator(nn.Module):
     num_layers: int = 6
     padding_mode: str = "reflect"
     weight_norm_type: str = ""
+    # named jax.checkpoint policy over the residual trunk
+    # (optim.remat.POLICIES)
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, images, labels=None, training=False):
@@ -43,10 +47,12 @@ class FUNITResDiscriminator(nn.Module):
                         name="conv_in")(images, training=training)
         for i in range(self.num_layers):
             nf_next = min(nf * 2, self.max_num_filters)
-            x = Res2dBlock(nf, name=f"res_{i}_0", **common)(
+            x = remat_block(Res2dBlock, self.remat, where="dis.remat",
+                            out_channels=nf, name=f"res_{i}_0", **common)(
                 x, training=training)
-            x = Res2dBlock(nf_next, name=f"res_{i}_1", **common)(
-                x, training=training)
+            x = remat_block(Res2dBlock, self.remat, where="dis.remat",
+                            out_channels=nf_next, name=f"res_{i}_1",
+                            **common)(x, training=training)
             nf = nf_next
             if i != self.num_layers - 1:
                 x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)),
@@ -83,7 +89,8 @@ class Discriminator(nn.Module):
             max_num_filters=cfg_get(d, "max_num_filters", 1024),
             num_layers=cfg_get(d, "num_layers", 6),
             padding_mode=cfg_get(d, "padding_mode", "reflect"),
-            weight_norm_type=cfg_get(d, "weight_norm_type", ""))
+            weight_norm_type=cfg_get(d, "weight_norm_type", ""),
+            remat=cfg_get(d, "remat", "none"))
 
     def __call__(self, data, net_G_output, recon=True, training=False):
         out = {}

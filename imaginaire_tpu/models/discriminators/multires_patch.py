@@ -25,6 +25,7 @@ from flax import linen as nn
 
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.layers import Conv2dBlock
+from imaginaire_tpu.optim.remat import remat_block
 from imaginaire_tpu.utils.data import (
     get_paired_input_image_channel_number,
     get_paired_input_label_channel_number,
@@ -74,17 +75,22 @@ class NLayerPatchDiscriminator(nn.Module):
     max_num_filters: int = 512
     activation_norm_type: str = ""
     weight_norm_type: str = ""
+    # named jax.checkpoint policy over the conv stack
+    # (optim.remat.POLICIES)
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, x, training=False):
         pad = int(math.floor((self.kernel_size - 1.0) / 2))
 
         def block(ch, stride, name):
-            return Conv2dBlock(ch, kernel_size=self.kernel_size, stride=stride,
-                               padding=pad,
-                               weight_norm_type=self.weight_norm_type,
-                               activation_norm_type=self.activation_norm_type,
-                               nonlinearity="leakyrelu", order="CNA", name=name)
+            return remat_block(
+                Conv2dBlock, self.remat, where="dis.remat",
+                out_channels=ch, kernel_size=self.kernel_size, stride=stride,
+                padding=pad,
+                weight_norm_type=self.weight_norm_type,
+                activation_norm_type=self.activation_norm_type,
+                nonlinearity="leakyrelu", order="CNA", name=name)
 
         features = []
         nf = self.num_filters
@@ -113,6 +119,7 @@ class MultiResPatchDiscriminator(nn.Module):
     activation_norm_type: str = ""
     weight_norm_type: str = ""
     weight_shared: bool = False
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -121,13 +128,13 @@ class MultiResPatchDiscriminator(nn.Module):
             shared = NLayerPatchDiscriminator(
                 self.kernel_size, self.num_filters, self.num_layers,
                 self.max_num_filters, self.activation_norm_type,
-                self.weight_norm_type, name="d_shared")
+                self.weight_norm_type, self.remat, name="d_shared")
         for i in range(self.num_discriminators):
             inputs.append(x)
             d = shared if self.weight_shared else NLayerPatchDiscriminator(
                 self.kernel_size, self.num_filters, self.num_layers,
                 self.max_num_filters, self.activation_norm_type,
-                self.weight_norm_type, name=f"d_{i}")
+                self.weight_norm_type, self.remat, name=f"d_{i}")
             logits, feats = d(x, training=training)
             outputs.append(logits)
             features_list.append(feats)
@@ -152,6 +159,7 @@ class Discriminator(nn.Module):
             max_num_filters=cfg_get(self.dis_cfg, "max_num_filters", 512),
             activation_norm_type=cfg_get(self.dis_cfg, "activation_norm_type", "none"),
             weight_norm_type=cfg_get(self.dis_cfg, "weight_norm_type", "spectral"),
+            remat=cfg_get(self.dis_cfg, "remat", "none"),
         )
 
     def __call__(self, data, net_G_output, real=True, training=False):

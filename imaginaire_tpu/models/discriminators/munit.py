@@ -32,6 +32,7 @@ def _make_domain_dis(dis_cfg, patch_key, weight_shared, name):
             activation_norm_type=cfg_get(dis_cfg, "activation_norm_type", "none"),
             weight_norm_type=cfg_get(dis_cfg, "weight_norm_type", ""),
             weight_shared=weight_shared,
+            remat=cfg_get(dis_cfg, "remat", "none"),
             name=name)
     return ResDiscriminator(
         num_filters=cfg_get(dis_cfg, "num_filters", 64),
@@ -43,6 +44,7 @@ def _make_domain_dis(dis_cfg, patch_key, weight_shared, name):
         weight_norm_type=cfg_get(dis_cfg, "weight_norm_type", ""),
         aggregation=cfg_get(dis_cfg, "aggregation", "conv"),
         order=cfg_get(dis_cfg, "order", "pre_act"),
+        remat=cfg_get(dis_cfg, "remat", "none"),
         name=name)
 
 

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from imaginaire_tpu.layers import Conv2dBlock, Res2dBlock
+from imaginaire_tpu.optim.remat import remat_block
 
 
 class ResDiscriminator(nn.Module):
@@ -23,6 +24,9 @@ class ResDiscriminator(nn.Module):
     weight_norm_type: str = ""
     aggregation: str = "conv"
     order: str = "pre_act"
+    # named jax.checkpoint policy over the residual trunk
+    # (optim.remat.POLICIES)
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, images, training=False):
@@ -37,8 +41,9 @@ class ResDiscriminator(nn.Module):
             images, training=training)
         for i in range(self.num_layers):
             nf = min(nf * 2, self.max_num_filters)
-            x = Res2dBlock(nf, order=self.order, name=f"res_{i}", **common)(
-                x, training=training)
+            x = remat_block(Res2dBlock, self.remat, where="dis.remat",
+                            out_channels=nf, order=self.order,
+                            name=f"res_{i}", **common)(x, training=training)
             x = nn.avg_pool(x, (2, 2), strides=(2, 2))
         if self.aggregation == "pool":
             x = jnp.mean(x, axis=(1, 2), keepdims=True)

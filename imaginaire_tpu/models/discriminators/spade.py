@@ -33,6 +33,7 @@ class Discriminator(nn.Module):
         num_labels = get_paired_input_label_channel_number(data_cfg, video=video)
         num_filters = cfg_get(dis_cfg, "num_filters", 128)
         weight_norm_type = cfg_get(dis_cfg, "weight_norm_type", "spectral")
+        remat = cfg_get(dis_cfg, "remat", "none")
         self.num_discriminators = cfg_get(dis_cfg, "num_discriminators", 2)
         self.patch_ds = [
             NLayerPatchDiscriminator(
@@ -42,6 +43,7 @@ class Discriminator(nn.Module):
                 max_num_filters=cfg_get(dis_cfg, "max_num_filters", 512),
                 activation_norm_type=cfg_get(dis_cfg, "activation_norm_type", "none"),
                 weight_norm_type=weight_norm_type,
+                remat=remat,
                 name=f"patch_d_{i}",
             )
             for i in range(self.num_discriminators)
@@ -52,6 +54,7 @@ class Discriminator(nn.Module):
             kernel_size=cfg_get(dis_cfg, "fpse_kernel_size", 3),
             weight_norm_type=weight_norm_type,
             activation_norm_type=cfg_get(dis_cfg, "fpse_activation_norm_type", "none"),
+            remat=remat,
             name="fpse",
         )
 

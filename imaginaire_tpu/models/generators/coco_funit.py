@@ -36,6 +36,7 @@ class COCOFUNITTranslator(nn.Module):
         num_filters_mlp = cfg_get(g, "num_filters_mlp", 256)
         wn = cfg_get(g, "weight_norm_type", "")
         n_down_content = cfg_get(g, "num_downsamples_content", 2)
+        remat = cfg_get(g, "remat", "none")
         self.style_encoder = StyleEncoder(
             num_downsamples=cfg_get(g, "num_downsamples_style", 4),
             num_filters=nf, style_channels=self.style_dims,
@@ -43,11 +44,11 @@ class COCOFUNITTranslator(nn.Module):
         self.content_encoder = FUNITContentEncoder(
             num_downsamples=n_down_content,
             num_res_blocks=cfg_get(g, "num_res_blocks", 2),
-            num_filters=nf, weight_norm_type=wn)
+            num_filters=nf, weight_norm_type=wn, remat=remat)
         self.decoder = FUNITDecoder(
             num_upsamples=n_down_content,
             num_image_channels=cfg_get(g, "num_image_channels", 3),
-            weight_norm_type=wn)
+            weight_norm_type=wn, remat=remat)
         # universal style bias (ref: coco_funit.py:133)
         self.usb = self.param("usb", nn.initializers.normal(1.0),
                               (1, self.usb_dims))

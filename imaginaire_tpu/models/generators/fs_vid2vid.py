@@ -32,6 +32,11 @@ from imaginaire_tpu.model_utils.fs_vid2vid import (
     resample,
 )
 from imaginaire_tpu.models.generators.embedders import LabelEmbedder
+from imaginaire_tpu.optim.remat import (
+    call_hyper_block,
+    remat_block,
+    remat_hyper_block_cls,
+)
 from imaginaire_tpu.utils.data import (
     get_paired_input_image_channel_number,
     get_paired_input_label_channel_number,
@@ -47,6 +52,9 @@ class FSFlowGenerator(nn.Module):
     num_input_channels: int
     num_img_channels: int
     num_frames: int
+    # named jax.checkpoint policy over the residual trunk
+    # (optim.remat.POLICIES)
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, label, src_label, src_image, training=False):
@@ -75,10 +83,12 @@ class FSFlowGenerator(nn.Module):
         for i in range(num_downsamples):
             x = conv(nf(i + 1), f"down_{i}", stride=2)(x, training=training)
         for i in range(num_blocks):
-            x = Res2dBlock(nf(num_downsamples), kernel_size,
-                           padding=kernel_size // 2, weight_norm_type=wn,
-                           activation_norm_type=an, order="NACNAC",
-                           name=f"res_{i}")(x, training=training)
+            x = remat_block(Res2dBlock, self.remat, where="gen.remat",
+                            out_channels=nf(num_downsamples),
+                            kernel_size=kernel_size,
+                            padding=kernel_size // 2, weight_norm_type=wn,
+                            activation_norm_type=an, order="NACNAC",
+                            name=f"res_{i}")(x, training=training)
         res = x
         for i in reversed(range(num_downsamples)):
             x = upsample_2x(x)
@@ -489,8 +499,15 @@ class Generator(nn.Module):
         anp = dict(as_attrdict(cfg_get(gen_cfg, "activation_norm_params",
                                        {}) or {}))
         order = cfg_get(hyper_cfg, "hyper_block_order", "NAC")
+        self.remat = cfg_get(gen_cfg, "remat", "none")
 
-        self.up_blocks = [HyperRes2dBlock(
+        # setup-based module: store wrapped INSTANCES on self (flax
+        # registers modules reachable through lists, not closures); the
+        # hyper wrapper threads the predicted conv/norm weight pytrees
+        # through jax.checkpoint as traced positional args
+        up_cls = remat_hyper_block_cls(HyperRes2dBlock, self.remat,
+                                       where="gen.remat")
+        self.up_blocks = [up_cls(
             nf[i], kernel_size=kernel_size, weight_norm_type=wn,
             activation_norm_type=an, activation_norm_params=anp,
             order=order * 2, name=f"up_{i}")
@@ -505,7 +522,7 @@ class Generator(nn.Module):
         if self.warp_ref:
             self.flow_network_ref = FSFlowGenerator(
                 flow_cfg, num_input_channels, num_img_channels, 2,
-                name="flow_network_ref")
+                remat=self.remat, name="flow_network_ref")
             self.ref_image_embedding = LabelEmbedder(
                 cfg_get(msc, "embed", None), num_img_channels + 1,
                 name="ref_image_embedding")
@@ -515,7 +532,8 @@ class Generator(nn.Module):
         if self.sep_prev_flownet:
             self.flow_network_temp = FSFlowGenerator(
                 flow_cfg, num_input_channels, num_img_channels,
-                self.num_frames_G, name="flow_network_temp")
+                self.num_frames_G, remat=self.remat,
+                name="flow_network_temp")
         else:
             self.flow_network_temp = self.flow_network_ref
         self.sep_prev_embedding = cfg_get(msc, "sep_warp_embed", False) or \
@@ -583,8 +601,9 @@ class Generator(nn.Module):
         return encoded_label
 
     def _one_up_layer(self, x, cond, conv_w, norm_w, i, training):
-        x = self.up_blocks[i](x, *cond, conv_weights=conv_w,
-                              norm_weights=norm_w, training=training)
+        x = call_hyper_block(self.up_blocks[i], x, *cond,
+                             conv_weights=conv_w, norm_weights=norm_w,
+                             training=training)
         if i != 0:
             x = upsample_2x(x)
         return x

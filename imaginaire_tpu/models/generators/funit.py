@@ -18,6 +18,7 @@ from flax import linen as nn
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.layers import Conv2dBlock, Res2dBlock, UpRes2dBlock
 from imaginaire_tpu.models.generators.munit import MLP, StyleEncoder
+from imaginaire_tpu.optim.remat import remat_block
 
 
 class FUNITContentEncoder(nn.Module):
@@ -32,6 +33,9 @@ class FUNITContentEncoder(nn.Module):
     activation_norm_type: str = "instance"
     weight_norm_type: str = ""
     nonlinearity: str = "relu"
+    # named jax.checkpoint policy over the residual trunk
+    # (optim.remat.POLICIES)
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -47,8 +51,9 @@ class FUNITContentEncoder(nn.Module):
             x = Conv2dBlock(nf, 4, stride=2, padding=1, name=f"down_{i}",
                             **common)(x, training=training)
         for i in range(self.num_res_blocks):
-            x = Res2dBlock(nf, order="CNACNA", name=f"res_{i}",
-                           **common)(x, training=training)
+            x = remat_block(Res2dBlock, self.remat, where="gen.remat",
+                            out_channels=nf, order="CNACNA", name=f"res_{i}",
+                            **common)(x, training=training)
         return x
 
 
@@ -61,6 +66,7 @@ class FUNITDecoder(nn.Module):
     padding_mode: str = "reflect"
     weight_norm_type: str = ""
     nonlinearity: str = "relu"
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, x, style, training=False):
@@ -71,14 +77,17 @@ class FUNITDecoder(nn.Module):
                      nonlinearity=self.nonlinearity)
         nf = x.shape[-1]
         for i in range(2):
-            x = Res2dBlock(nf, kernel_size=3, padding=1, name=f"res_{i}",
-                           **adain)(x, style, training=training)
-        for i in range(self.num_upsamples):
-            x = UpRes2dBlock(nf // 2, kernel_size=5, padding=2,
-                             hidden_channels_equal_out_channels=True,
-                             skip_nonlinearity=True,
-                             name=f"up_{i}", **adain)(x, style,
+            x = remat_block(Res2dBlock, self.remat, where="gen.remat",
+                            out_channels=nf, kernel_size=3, padding=1,
+                            name=f"res_{i}", **adain)(x, style,
                                                       training=training)
+        for i in range(self.num_upsamples):
+            x = remat_block(UpRes2dBlock, self.remat, where="gen.remat",
+                            out_channels=nf // 2, kernel_size=5, padding=2,
+                            hidden_channels_equal_out_channels=True,
+                            skip_nonlinearity=True,
+                            name=f"up_{i}", **adain)(x, style,
+                                                     training=training)
             nf //= 2
         return Conv2dBlock(self.num_image_channels, 7, stride=1, padding=3,
                            padding_mode="reflect", nonlinearity="tanh",
@@ -97,6 +106,7 @@ class FUNITTranslator(nn.Module):
         num_filters_mlp = cfg_get(g, "num_filters_mlp", 256)
         wn = cfg_get(g, "weight_norm_type", "")
         n_down_content = cfg_get(g, "num_downsamples_content", 2)
+        remat = cfg_get(g, "remat", "none")
         self.style_encoder = StyleEncoder(
             num_downsamples=cfg_get(g, "num_downsamples_style", 4),
             num_filters=nf, style_channels=self.style_dims,
@@ -104,11 +114,11 @@ class FUNITTranslator(nn.Module):
         self.content_encoder = FUNITContentEncoder(
             num_downsamples=n_down_content,
             num_res_blocks=cfg_get(g, "num_res_blocks", 2),
-            num_filters=nf, weight_norm_type=wn)
+            num_filters=nf, weight_norm_type=wn, remat=remat)
         self.decoder = FUNITDecoder(
             num_upsamples=n_down_content,
             num_image_channels=cfg_get(g, "num_image_channels", 3),
-            weight_norm_type=wn)
+            weight_norm_type=wn, remat=remat)
         # FUNIT MLP has num_layers-3 hidden blocks (ref: funit.py:380-383)
         self.mlp = MLP(output_dim=num_filters_mlp, latent_dim=num_filters_mlp,
                        num_layers=cfg_get(g, "num_mlp_blocks", 3) - 1)

@@ -22,6 +22,7 @@ from flax import linen as nn
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.layers import Conv2dBlock, LinearBlock, Res2dBlock
 from imaginaire_tpu.models.generators.unit import ContentEncoder
+from imaginaire_tpu.optim.remat import remat_block
 from imaginaire_tpu.utils.misc import upsample_2x
 
 
@@ -93,6 +94,9 @@ class AdaINDecoder(nn.Module):
     output_nonlinearity: str = ""
     pre_act: bool = False
     apply_noise: bool = False
+    # named jax.checkpoint policy over the residual trunk
+    # (optim.remat.POLICIES)
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, x, style, training=False):
@@ -106,8 +110,9 @@ class AdaINDecoder(nn.Module):
         order = "pre_act" if self.pre_act else "CNACNA"
         nf = x.shape[-1]
         for i in range(self.num_res_blocks):
-            x = Res2dBlock(nf, order=order, name=f"res_{i}", **common)(
-                x, style, training=training)
+            x = remat_block(Res2dBlock, self.remat, where="gen.remat",
+                            out_channels=nf, order=order, name=f"res_{i}",
+                            **common)(x, style, training=training)
         for i in range(self.num_upsamples):
             x = upsample_2x(x)
             x = Conv2dBlock(nf // 2, 5, stride=1, padding=2, name=f"up_{i}",
@@ -141,7 +146,8 @@ class AutoEncoder(nn.Module):
             max_num_filters=cfg_get(g, "max_num_filters", 256),
             activation_norm_type=cfg_get(g, "content_norm_type", "instance"),
             weight_norm_type=cfg_get(g, "weight_norm_type", ""),
-            pre_act=cfg_get(g, "pre_act", False))
+            pre_act=cfg_get(g, "pre_act", False),
+            remat=cfg_get(g, "remat", "none"))
         self.decoder = AdaINDecoder(
             num_upsamples=cfg_get(g, "num_downsamples_content", 2),
             num_res_blocks=cfg_get(g, "num_res_blocks", 4),
@@ -151,7 +157,8 @@ class AutoEncoder(nn.Module):
             weight_norm_type=cfg_get(g, "weight_norm_type", ""),
             output_nonlinearity=cfg_get(g, "output_nonlinearity", ""),
             pre_act=cfg_get(g, "pre_act", False),
-            apply_noise=cfg_get(g, "apply_noise", False))
+            apply_noise=cfg_get(g, "apply_noise", False),
+            remat=cfg_get(g, "remat", "none"))
         self.mlp = MLP(output_dim=num_filters_mlp,
                        latent_dim=num_filters_mlp,
                        num_layers=cfg_get(g, "num_mlp_blocks", 2))

@@ -24,6 +24,7 @@ from flax import linen as nn
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.layers import Conv2dBlock, Res2dBlock
 from imaginaire_tpu.model_utils.pix2pixHD import instance_average
+from imaginaire_tpu.optim.remat import remat_block
 from imaginaire_tpu.utils.misc import upsample_2x
 from imaginaire_tpu.utils.data import (
     get_paired_input_image_channel_number,
@@ -52,6 +53,9 @@ class GlobalGenerator(nn.Module):
     activation_norm_type: str = "instance"
     activation_norm_params: Optional[Any] = None
     output_img: bool = True
+    # named jax.checkpoint policy over the residual trunk
+    # (optim.remat.POLICIES)
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -68,13 +72,15 @@ class GlobalGenerator(nn.Module):
                             name=f"down_{i}", **common)(x, training=training)
         ch = self.num_filters * (2 ** self.num_downsamples)
         for i in range(self.num_res_blocks):
-            x = Res2dBlock(ch, 3, padding=1, order="CNACN",
-                           padding_mode=self.padding_mode,
-                           weight_norm_type=self.weight_norm_type,
-                           activation_norm_type=self.activation_norm_type,
-                           activation_norm_params=self.activation_norm_params,
-                           nonlinearity="relu",
-                           name=f"res_{i}")(x, training=training)
+            x = remat_block(Res2dBlock, self.remat, where="gen.remat",
+                            out_channels=ch, kernel_size=3, padding=1,
+                            order="CNACN",
+                            padding_mode=self.padding_mode,
+                            weight_norm_type=self.weight_norm_type,
+                            activation_norm_type=self.activation_norm_type,
+                            activation_norm_params=self.activation_norm_params,
+                            nonlinearity="relu",
+                            name=f"res_{i}")(x, training=training)
         for i in reversed(range(self.num_downsamples)):
             ch = self.num_filters * (2 ** i)
             x = upsample_2x(x)
@@ -101,6 +107,7 @@ class LocalEnhancer(nn.Module):
     activation_norm_type: str = "instance"
     activation_norm_params: Optional[Any] = None
     output_img: bool = False
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, output_coarse, input_fine, training=False):
@@ -115,13 +122,15 @@ class LocalEnhancer(nn.Module):
                         name="down_1", **common)(x, training=training)
         x = x + output_coarse
         for i in range(self.num_res_blocks):
-            x = Res2dBlock(self.num_filters * 2, 3, padding=1, order="CNACN",
-                           padding_mode=self.padding_mode,
-                           weight_norm_type=self.weight_norm_type,
-                           activation_norm_type=self.activation_norm_type,
-                           activation_norm_params=self.activation_norm_params,
-                           nonlinearity="relu",
-                           name=f"res_{i}")(x, training=training)
+            x = remat_block(Res2dBlock, self.remat, where="gen.remat",
+                            out_channels=self.num_filters * 2, kernel_size=3,
+                            padding=1, order="CNACN",
+                            padding_mode=self.padding_mode,
+                            weight_norm_type=self.weight_norm_type,
+                            activation_norm_type=self.activation_norm_type,
+                            activation_norm_params=self.activation_norm_params,
+                            nonlinearity="relu",
+                            name=f"res_{i}")(x, training=training)
         x = upsample_2x(x)
         x = Conv2dBlock(self.num_filters, 3, padding=1, name="up_0",
                         **common)(x, training=training)
@@ -195,6 +204,7 @@ class Generator(nn.Module):
         anp = cfg_get(gen_cfg, "activation_norm_params", None)
         num_img = get_paired_input_image_channel_number(data_cfg)
         num_in = get_paired_input_label_channel_number(data_cfg)
+        remat = cfg_get(gen_cfg, "remat", "none")
 
         input_labels = list(cfg_get(data_cfg, "input_labels", []) or [])
         self.contain_instance_map = bool(input_labels) and \
@@ -226,6 +236,7 @@ class Generator(nn.Module):
             activation_norm_type=an,
             activation_norm_params=anp,
             output_img=(self.num_enhancers == 0),
+            remat=remat,
             name="global")
         enhancers = []
         for n in range(self.num_enhancers):
@@ -238,6 +249,7 @@ class Generator(nn.Module):
                 activation_norm_type=an,
                 activation_norm_params=anp,
                 output_img=(n == self.num_enhancers - 1),
+                remat=remat,
                 name=f"enhancer_{n}"))
         self.enhancers = enhancers
 

@@ -32,6 +32,7 @@ from flax import linen as nn
 from imaginaire_tpu.utils.misc import upsample_2x
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.layers import Conv2dBlock, LinearBlock, Res2dBlock
+from imaginaire_tpu.optim.remat import remat_block
 from imaginaire_tpu.utils.data import (
     get_crop_or_resize_h_w,
     get_paired_input_image_channel_number,
@@ -128,15 +129,6 @@ class Generator(nn.Module):
         return out["fake_images"]
 
 
-class _PositionalRes2dBlock(Res2dBlock):
-    """Res2dBlock whose ``training`` flag is positional: kw-only args
-    cannot be named in ``nn.remat``'s static_argnums, and under remat a
-    kwarg bool is traced, breaking the block's control flow."""
-
-    def __call__(self, x, seg, training):  # noqa: D102
-        return super().__call__(x, seg, training=training)
-
-
 class SPADEGenerator(nn.Module):
     """The up-ladder core (ref: spade.py:217-493)."""
 
@@ -158,8 +150,8 @@ class SPADEGenerator(nn.Module):
     # knob makes it — and its ring-attention sequence-parallel mode —
     # reachable from configs).
     non_local_params: Any = None
-    # 'blocks' rematerializes each SPADE res block in the backward pass
-    # (jax.checkpoint): activation HBM traded for recompute FLOPs. The
+    # Named jax.checkpoint policy over each SPADE res block: activation
+    # HBM traded for recompute FLOPs (optim.remat.POLICIES). The
     # parameter tree is unchanged, so the knob can toggle mid-training.
     remat: str = "none"
 
@@ -176,28 +168,16 @@ class SPADEGenerator(nn.Module):
         ks = self.kernel_size
         pad = int(math.ceil((ks - 1.0) / 2))
 
-        if self.remat not in ("none", "blocks"):
-            raise ValueError(
-                f"gen.remat={self.remat!r} is not a known policy; use "
-                "'none' or 'blocks'")
-
         def res_block(out_ch, name):
-            block_kw = dict(
+            return remat_block(
+                Res2dBlock, self.remat, where="gen.remat",
+                out_channels=out_ch,
                 kernel_size=ks, padding=pad, bias=[True, True, False],
                 weight_norm_type=self.weight_norm_type,
                 activation_norm_type="spatially_adaptive",
                 activation_norm_params=self.activation_norm_params,
                 skip_activation_norm=self.skip_activation_norm,
                 nonlinearity="leakyrelu", order="NACNAC", name=name)
-            if self.remat == "blocks":
-                # training must be a STATIC positional arg under remat —
-                # a traced bool would break the blocks' Python control
-                # flow (_PositionalRes2dBlock exists for exactly this);
-                # the param tree is unchanged vs the plain block
-                blk = nn.remat(_PositionalRes2dBlock,
-                               static_argnums=(3,))(out_ch, **block_kw)
-                return lambda x, seg, training=False: blk(x, seg, training)
-            return Res2dBlock(out_ch, **block_kw)
 
         def cbn_block(out_ch, name):
             # Global AdaIN-conditioned conv (ref: spade.py:287-307).

@@ -21,6 +21,7 @@ from flax import linen as nn
 
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.layers import Conv2dBlock, Res2dBlock
+from imaginaire_tpu.optim.remat import remat_block
 from imaginaire_tpu.utils.misc import upsample_2x
 
 
@@ -37,6 +38,9 @@ class ContentEncoder(nn.Module):
     weight_norm_type: str = ""
     nonlinearity: str = "relu"
     pre_act: bool = False
+    # named jax.checkpoint policy over the residual trunk
+    # (optim.remat.POLICIES)
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -53,8 +57,9 @@ class ContentEncoder(nn.Module):
             x = Conv2dBlock(nf, 4, stride=2, padding=1, name=f"down_{i}",
                             **common)(x, training=training)
         for i in range(self.num_res_blocks):
-            x = Res2dBlock(nf, order=order, name=f"res_{i}",
-                           **common)(x, training=training)
+            x = remat_block(Res2dBlock, self.remat, where="gen.remat",
+                            out_channels=nf, order=order, name=f"res_{i}",
+                            **common)(x, training=training)
         return x
 
 
@@ -72,6 +77,7 @@ class Decoder(nn.Module):
     output_nonlinearity: str = ""
     pre_act: bool = False
     apply_noise: bool = False
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, x, training=False):
@@ -83,8 +89,9 @@ class Decoder(nn.Module):
         order = "pre_act" if self.pre_act else "CNACNA"
         nf = x.shape[-1]
         for i in range(self.num_res_blocks):
-            x = Res2dBlock(nf, order=order, name=f"res_{i}",
-                           **common)(x, training=training)
+            x = remat_block(Res2dBlock, self.remat, where="gen.remat",
+                            out_channels=nf, order=order, name=f"res_{i}",
+                            **common)(x, training=training)
         for i in range(self.num_upsamples):
             x = upsample_2x(x)
             x = Conv2dBlock(nf // 2, 5, stride=1, padding=2, name=f"up_{i}",
@@ -110,7 +117,8 @@ class AutoEncoder(nn.Module):
             max_num_filters=cfg_get(g, "max_num_filters", 256),
             activation_norm_type=cfg_get(g, "content_norm_type", "instance"),
             weight_norm_type=cfg_get(g, "weight_norm_type", ""),
-            pre_act=cfg_get(g, "pre_act", False))
+            pre_act=cfg_get(g, "pre_act", False),
+            remat=cfg_get(g, "remat", "none"))
         self.decoder = Decoder(
             num_upsamples=cfg_get(g, "num_downsamples_content", 2),
             num_res_blocks=cfg_get(g, "num_res_blocks", 4),
@@ -119,7 +127,8 @@ class AutoEncoder(nn.Module):
             weight_norm_type=cfg_get(g, "weight_norm_type", ""),
             output_nonlinearity=cfg_get(g, "output_nonlinearity", ""),
             pre_act=cfg_get(g, "pre_act", False),
-            apply_noise=cfg_get(g, "apply_noise", False))
+            apply_noise=cfg_get(g, "apply_noise", False),
+            remat=cfg_get(g, "remat", "none"))
 
     def __call__(self, images, training=False):
         return self.decoder(self.content_encoder(images, training=training),

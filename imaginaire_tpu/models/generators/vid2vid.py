@@ -29,6 +29,7 @@ from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.layers import Conv2dBlock, LinearBlock, Res2dBlock
 from imaginaire_tpu.model_utils.fs_vid2vid import fold_time, resample
 from imaginaire_tpu.models.generators.embedders import LabelEmbedder
+from imaginaire_tpu.optim.remat import call_block, remat_block, remat_block_cls
 from imaginaire_tpu.utils.data import (
     get_paired_input_image_channel_number,
     get_paired_input_label_channel_number,
@@ -50,6 +51,9 @@ class FlowGenerator(nn.Module):
     num_input_channels: int
     num_prev_img_channels: int
     num_frames: int
+    # named jax.checkpoint policy over the residual trunk
+    # (optim.remat.POLICIES)
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, label, img_prev, training=False):
@@ -81,10 +85,12 @@ class FlowGenerator(nn.Module):
                 img, training=training)
         x = lbl + img
         for i in range(num_res_blocks):
-            x = Res2dBlock(nf(num_downsamples), kernel_size,
-                           padding=kernel_size // 2, weight_norm_type=wn,
-                           activation_norm_type=an, order="CNACN",
-                           name=f"res_{i}")(x, training=training)
+            x = remat_block(Res2dBlock, self.remat, where="gen.remat",
+                            out_channels=nf(num_downsamples),
+                            kernel_size=kernel_size,
+                            padding=kernel_size // 2, weight_norm_type=wn,
+                            activation_norm_type=an, order="CNACN",
+                            name=f"res_{i}")(x, training=training)
         for i in reversed(range(num_downsamples)):
             x = upsample_2x(x)
             x = conv(nf(i), f"up_{i}")(x, training=training)
@@ -168,12 +174,19 @@ class Generator(nn.Module):
         def nf(i):
             return min(self.max_num_filters, self.num_filters * (2 ** i))
 
+        self.remat = cfg_get(gen_cfg, "remat", "none")
+
         def res_block(ch, name):
-            return Res2dBlock(ch, self.kernel_size, padding=padding,
-                              weight_norm_type=wn, activation_norm_type=an,
-                              activation_norm_params=anp,
-                              nonlinearity="leakyrelu", order="NACNAC",
-                              name=name)
+            # setup-based module: the wrapped INSTANCE is stored on self
+            # (flax registers modules reachable through lists, not
+            # closures) and dispatched via optim.remat.call_block
+            return remat_block_cls(Res2dBlock, self.remat,
+                                   where="gen.remat")(
+                ch, self.kernel_size, padding=padding,
+                weight_norm_type=wn, activation_norm_type=an,
+                activation_norm_params=anp,
+                nonlinearity="leakyrelu", order="NACNAC",
+                name=name)
 
         # Main up branch: one block per scale, index i = scale i.
         self.up_blocks = [res_block(nf(i), f"up_{i}")
@@ -205,7 +218,8 @@ class Generator(nn.Module):
         if self.has_flow:
             self.flow_network_temp = FlowGenerator(
                 flow_cfg, self.num_input_channels, self.num_img_channels,
-                self.num_frames_G, name="flow_network_temp")
+                self.num_frames_G, remat=self.remat,
+                name="flow_network_temp")
             if self.spade_combine:
                 self.img_prev_embedding = LabelEmbedder(
                     cfg_get(msc, "embed", None) or emb_cfg,
@@ -235,7 +249,8 @@ class Generator(nn.Module):
             x = self.fc(z, training=training).reshape(b, self.sh, self.sw, -1)
         for i in range(self.num_layers, self.num_downsamples_img, -1):
             j = min(self.num_downsamples_embed, i)
-            x = self.up_blocks[i](x, *cond_maps_now[j], training=training)
+            x = call_block(self.up_blocks[i], x, *cond_maps_now[j],
+                           training=training)
             x = upsample_2x(x)
         return x
 
@@ -247,14 +262,15 @@ class Generator(nn.Module):
                                             self.label_embedding, training)
         for i in range(self.num_downsamples_img + 1):
             j = min(self.num_downsamples_embed, i)
-            x = self.down_blocks[i](x, *cond_maps_prev[j], training=training)
+            x = call_block(self.down_blocks[i], x, *cond_maps_prev[j],
+                           training=training)
             if i != self.num_downsamples_img:
                 x = _avgpool3s2(x)
         j = min(self.num_downsamples_embed, self.num_downsamples_img + 1)
         for i in range(self.num_res_blocks):
             cond = (cond_maps_prev[j] if i < self.num_res_blocks // 2
                     else cond_maps_now[j])
-            x = self.res_blocks[i](x, *cond, training=training)
+            x = call_block(self.res_blocks[i], x, *cond, training=training)
         return x
 
     def _flow_warp(self, label, label_prev, img_prev, training):
@@ -268,7 +284,7 @@ class Generator(nn.Module):
         return flow, mask, img_warp
 
     def _one_up_layer(self, x, cond_maps, i, training):
-        x = self.up_blocks[i](x, *cond_maps, training=training)
+        x = call_block(self.up_blocks[i], x, *cond_maps, training=training)
         if i != 0:
             x = upsample_2x(x)
         return x

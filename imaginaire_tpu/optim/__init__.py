@@ -13,6 +13,17 @@ from imaginaire_tpu.optim.optimizers import (
     init_optimizer_state,
     madam,
 )
+from imaginaire_tpu.optim.remat import (
+    POLICIES as REMAT_POLICIES,
+    call_block,
+    call_hyper_block,
+    remat_block,
+    remat_block_cls,
+    remat_hyper_block_cls,
+    resolve_policy,
+)
 
 __all__ = ["fromage", "madam", "get_optimizer_for_params", "get_scheduler",
-           "init_optimizer_state"]
+           "init_optimizer_state", "REMAT_POLICIES", "resolve_policy",
+           "remat_block", "remat_block_cls", "remat_hyper_block_cls",
+           "call_block", "call_hyper_block"]

@@ -91,8 +91,20 @@ class BaseTrainer:
         # (ref: utils/trainer.py:152-154). Master params stay fp32; the
         # forward/backward runs in compute_dtype (the cast is differentiable,
         # so grads accumulate back into fp32). bf16 shares fp32's exponent
-        # range, so no loss scaler is needed.
-        self.compute_dtype = jnp.dtype(cfg_get(tcfg, "compute_dtype", "float32"))
+        # range, so no loss scaler is needed. fp32 islands survive the
+        # cast: norm statistics (activation_norm), SN power iteration
+        # ('spectral' collection), loss accumulation, and audit norms.
+        # cfg.trainer.mixed_precision is the structured knob; the legacy
+        # scalar cfg.trainer.compute_dtype still works when it is absent
+        # or disabled.
+        mp = as_attrdict(cfg_get(tcfg, "mixed_precision", None) or {})
+        if cfg_get(mp, "enabled", False):
+            self.compute_dtype = jnp.dtype(
+                cfg_get(mp, "compute_dtype", "bfloat16"))
+        else:
+            self.compute_dtype = jnp.dtype(
+                cfg_get(tcfg, "compute_dtype", "float32"))
+        self.mixed_precision = self.compute_dtype != jnp.float32
 
         # Loss registry (ref: base.py:163-197): subclasses fill weights in
         # _init_loss; loss values come from gen_forward/dis_forward.
@@ -284,6 +296,16 @@ class BaseTrainer:
             lambda x: x.astype(dt)
             if hasattr(x, "dtype") and x.dtype == jnp.float32 else x, tree)
 
+    def _cast_net_vars(self, variables):
+        """Compute-dtype view of a network's variables: cast ONLY the
+        ``params`` collection. The fp32 islands — ``batch_stats`` running
+        moments and the SN ``spectral`` u vectors — keep their dtype so
+        statistics/power-iteration stay full-precision under bf16."""
+        if variables is None or self.compute_dtype == jnp.float32:
+            return variables
+        return dict(variables,
+                    params=self._to_compute_dtype(variables["params"]))
+
     def _total(self, losses):
         """Weighted sum over registered losses (ref: base.py:698-714)."""
         total = jnp.zeros(())
@@ -342,7 +364,7 @@ class BaseTrainer:
         def loss_fn(params_G):
             vars_G = dict(state["vars_G"], params=self._to_compute_dtype(params_G))
             losses, new_mut = self.gen_forward(
-                vars_G, self._to_compute_dtype(state.get("vars_D")),
+                vars_G, self._cast_net_vars(state.get("vars_D")),
                 state["loss_params"], self._to_compute_dtype(data), rng)
             losses = {k: v.astype(jnp.float32) for k, v in losses.items()}
             total = self._total(losses)
@@ -383,7 +405,7 @@ class BaseTrainer:
         def loss_fn(params_D):
             vars_D = dict(state["vars_D"], params=self._to_compute_dtype(params_D))
             losses, new_mut = self.dis_forward(
-                self._to_compute_dtype(state["vars_G"]), vars_D,
+                self._cast_net_vars(state["vars_G"]), vars_D,
                 state["loss_params"], self._to_compute_dtype(data), rng)
             losses = {k: v.astype(jnp.float32) for k, v in losses.items()}
             total = self._total(losses)

@@ -442,7 +442,7 @@ class Trainer(BaseTrainer):
             vars_G = dict(state["vars_G"],
                           params=self._to_compute_dtype(params_G))
             losses, new_mut, out = self.gen_forward(
-                vars_G, self._to_compute_dtype(state["vars_D"]),
+                vars_G, self._cast_net_vars(state["vars_D"]),
                 state["loss_params"], self._to_compute_dtype(data), rng)
             losses = {k: v.astype(jnp.float32) for k, v in losses.items()}
             total = self._total(losses)
@@ -487,7 +487,7 @@ class Trainer(BaseTrainer):
             vars_D = dict(state["vars_D"],
                           params=self._to_compute_dtype(params_D))
             losses, new_mut = self.dis_forward(
-                self._to_compute_dtype(state["vars_G"]), vars_D,
+                self._cast_net_vars(state["vars_G"]), vars_D,
                 state["loss_params"], self._to_compute_dtype(data), rng)
             losses = {k: v.astype(jnp.float32) for k, v in losses.items()}
             total = self._total(losses)

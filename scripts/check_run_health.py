@@ -47,7 +47,7 @@ from imaginaire_tpu.telemetry.report import (  # noqa: E402
 
 def check_health(summary, require_health=False, max_dg_breaches=0,
                  max_recompiles=0, mem_budget_frac=None,
-                 max_fallbacks=0):
+                 max_fallbacks=0, max_temp_frac=None):
     """Return the list of failure strings for an aggregated summary."""
     failures = []
     health = summary.get("health") or {}
@@ -98,6 +98,28 @@ def check_health(summary, require_health=False, max_dg_breaches=0,
         failures.append(
             f"peak HBM watermark {peak_frac:.1%} of bytes_limit "
             f"exceeds --mem-budget-frac {mem_budget_frac:g}")
+    # Activation-memory gate (ISSUE 10): the worst per-executable XLA
+    # temp allocation — the rematerializable part of the footprint —
+    # against bytes_limit, from the ledger's static budget report. A
+    # breach means the remat/dtype policy regressed (e.g. a config
+    # edit silently dropped `remat: blocks`). Runs without a
+    # mem_budget meta (observability off, CPU) pass unchanged.
+    budget = (summary.get("meta") or {}).get("mem_budget") or {}
+    bytes_limit = budget.get("bytes_limit")
+    if max_temp_frac is not None and bytes_limit:
+        worst_label, worst_temp = None, -1
+        for label, mem in (budget.get("executables") or {}).items():
+            t = (mem or {}).get("temp_bytes")
+            if t is not None and int(t) > worst_temp:
+                worst_label, worst_temp = label, int(t)
+        if worst_label is not None:
+            temp_frac = worst_temp / float(bytes_limit)
+            if temp_frac > max_temp_frac:
+                failures.append(
+                    f"executable {worst_label!r} temp allocation "
+                    f"{temp_frac:.1%} of bytes_limit exceeds "
+                    f"--max-temp-frac {max_temp_frac:g} "
+                    f"({worst_temp} bytes)")
     if xla.get("oom_events"):
         failures.append(
             f"{len(xla['oom_events'])} RESOURCE_EXHAUSTED event(s) — "
@@ -172,6 +194,11 @@ def main(argv=None):
                     help="fail when the peak HBM watermark exceeds "
                          "this fraction of bytes_limit (default: no "
                          "memory gate)")
+    ap.add_argument("--max-temp-frac", type=float, default=None,
+                    help="fail when any ledger executable's XLA temp "
+                         "allocation exceeds this fraction of "
+                         "bytes_limit (reads the mem_budget meta; "
+                         "default: no temp gate)")
     ap.add_argument("--max-fallbacks", type=int, default=0,
                     help="tolerated corrupt-checkpoint fallbacks "
                          "(resilience/ckpt_fallbacks; default 0 — "
@@ -203,7 +230,8 @@ def main(argv=None):
                             max_dg_breaches=args.max_dg_breaches,
                             max_recompiles=args.max_recompiles,
                             mem_budget_frac=args.mem_budget_frac,
-                            max_fallbacks=args.max_fallbacks)
+                            max_fallbacks=args.max_fallbacks,
+                            max_temp_frac=args.max_temp_frac)
     health = summary.get("health") or {}
     xla = summary.get("xla") or {}
     res = summary.get("resilience") or {}
@@ -267,7 +295,8 @@ def _main_hosts(args):
                                 max_dg_breaches=args.max_dg_breaches,
                                 max_recompiles=args.max_recompiles,
                                 mem_budget_frac=args.mem_budget_frac,
-                                max_fallbacks=args.max_fallbacks)
+                                max_fallbacks=args.max_fallbacks,
+                                max_temp_frac=args.max_temp_frac)
         verdicts[label] = {"path": fpath, "healthy": not failures,
                            "failures": failures}
         any_fail = any_fail or bool(failures)

@@ -67,7 +67,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--bs", default="4,8,16")
     ap.add_argument("--remat", default=None,
-                    help="override cfg.gen.remat (none|blocks)")
+                    help="override cfg.gen.remat; any name in "
+                         "imaginaire_tpu.optim.remat.POLICIES "
+                         "(none|blocks|dots_saveable|save_nothing)")
     ap.add_argument("--flops-bs", type=int, default=4,
                     help="batch size for the cost-analysis/MFU report")
     args = ap.parse_args()

@@ -96,8 +96,8 @@ def main():
     @jax.jit
     def gen_loss_fwd(state, data):
         losses, _ = trainer.gen_forward(
-            trainer._to_compute_dtype(state["vars_G"]),
-            trainer._to_compute_dtype(state["vars_D"]),
+            trainer._cast_net_vars(state["vars_G"]),
+            trainer._cast_net_vars(state["vars_D"]),
             state["loss_params"], trainer._to_compute_dtype(data), rng)
         return trainer._total(
             {k: v.astype(jnp.float32) for k, v in losses.items()})
@@ -108,7 +108,7 @@ def main():
             vg = dict(state["vars_G"],
                       params=trainer._to_compute_dtype(params_G))
             losses, _ = trainer.gen_forward(
-                vg, trainer._to_compute_dtype(state["vars_D"]),
+                vg, trainer._cast_net_vars(state["vars_D"]),
                 state["loss_params"], trainer._to_compute_dtype(data), rng)
             return trainer._total(
                 {k: v.astype(jnp.float32) for k, v in losses.items()})
@@ -118,8 +118,8 @@ def main():
     @jax.jit
     def dis_loss_fwd(state, data):
         losses, _ = trainer.dis_forward(
-            trainer._to_compute_dtype(state["vars_G"]),
-            trainer._to_compute_dtype(state["vars_D"]),
+            trainer._cast_net_vars(state["vars_G"]),
+            trainer._cast_net_vars(state["vars_D"]),
             state["loss_params"], trainer._to_compute_dtype(data), rng)
         return losses["GAN"]
 
@@ -178,8 +178,8 @@ def main():
     trainer.state = None
     state = None
     comp_data = trainer._to_compute_dtype(data)
-    vars_G = trainer._to_compute_dtype(slim["vars_G"])
-    vars_D = trainer._to_compute_dtype(slim["vars_D"])
+    vars_G = trainer._cast_net_vars(slim["vars_G"])
+    vars_D = trainer._cast_net_vars(slim["vars_D"])
     fake = g_apply(vars_G, comp_data, rng)
 
     run_cases([

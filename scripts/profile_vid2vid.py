@@ -136,7 +136,7 @@ def main_variant(variant, with_temporal, flow_teacher, results):
 
     comp_data = trainer._to_compute_dtype(
         {k: v for k, v in data_t.items() if k != "past_stacks"})
-    vars_G = trainer._to_compute_dtype(trainer.state["vars_G"])
+    vars_G = trainer._cast_net_vars(trainer.state["vars_G"])
 
     cases = [("dis_frame_step", dis_frame),
              ("gen_frame_step", gen_frame),

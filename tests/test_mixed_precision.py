@@ -1,0 +1,164 @@
+"""fp32 islands under the bf16 compute policy (ISSUE 10): the params
+cast is surgical — norm statistics, spectral-norm power iteration, and
+health-audit norms stay float32, and the runtime asserts refuse a bf16
+leak instead of silently degrading."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from imaginaire_tpu.config import Config
+from imaginaire_tpu.layers.activation_norm import InstanceNorm, LayerNorm2d
+from imaginaire_tpu.layers.weight_norm import (
+    estimate_sigma,
+    power_iteration,
+    spectral_normalize,
+)
+from imaginaire_tpu.trainers.base import BaseTrainer
+
+import os
+
+CFG_PATH = os.path.join(os.path.dirname(__file__), "..", "configs",
+                        "unit_test", "spade.yaml")
+
+
+class _Caster:
+    """BaseTrainer's cast helpers without the ctor: the methods only
+    touch ``self.compute_dtype``."""
+
+    _to_compute_dtype = BaseTrainer._to_compute_dtype
+    _cast_net_vars = BaseTrainer._cast_net_vars
+
+    def __init__(self, dtype):
+        self.compute_dtype = jnp.dtype(dtype)
+
+
+def _net_vars():
+    return {
+        "params": {"conv": {"kernel": jnp.ones((3, 3, 4, 8), jnp.float32),
+                            "bias": jnp.zeros((8,), jnp.float32)}},
+        "batch_stats": {"bn": {"mean": jnp.zeros((8,), jnp.float32)}},
+        "spectral": {"conv": {"u": jnp.ones((8,), jnp.float32)}},
+    }
+
+
+class TestCastNetVars:
+    def test_params_only(self):
+        out = _Caster("bfloat16")._cast_net_vars(_net_vars())
+        assert out["params"]["conv"]["kernel"].dtype == jnp.bfloat16
+        assert out["params"]["conv"]["bias"].dtype == jnp.bfloat16
+        # the fp32 islands keep their dtype
+        assert out["batch_stats"]["bn"]["mean"].dtype == jnp.float32
+        assert out["spectral"]["conv"]["u"].dtype == jnp.float32
+
+    def test_fp32_policy_is_identity(self):
+        v = _net_vars()
+        assert _Caster("float32")._cast_net_vars(v) is v
+        assert _Caster("bfloat16")._cast_net_vars(None) is None
+
+    def test_non_float_leaves_untouched(self):
+        v = {"params": {"step": jnp.zeros((), jnp.int32)}}
+        out = _Caster("bfloat16")._cast_net_vars(v)
+        assert out["params"]["step"].dtype == jnp.int32
+
+
+class TestSpectralNormIsland:
+    def test_power_iteration_fp32_from_bf16_weights(self, rng):
+        w = jnp.asarray(rng.randn(8, 12).astype(np.float32))
+        u = jnp.asarray(rng.randn(8).astype(np.float32))
+        sigma, new_u = power_iteration(w.astype(jnp.bfloat16), u)
+        assert sigma.dtype == jnp.float32
+        assert new_u.dtype == jnp.float32
+        sigma32, _ = power_iteration(w, u)
+        # iteration ran on the (rounded) bf16 weights but in fp32 math
+        np.testing.assert_allclose(float(sigma), float(sigma32), rtol=2e-2)
+
+    def test_power_iteration_refuses_bf16_u(self, rng):
+        w = jnp.asarray(rng.randn(4, 6).astype(np.float32))
+        u = jnp.ones((4,), jnp.bfloat16)
+        with pytest.raises(AssertionError, match="float32"):
+            power_iteration(w, u)
+
+    def test_estimate_sigma_fp32_from_bf16(self, rng):
+        k = jnp.asarray(rng.randn(3, 3, 4, 8).astype(np.float32))
+        u = jnp.asarray(rng.randn(8).astype(np.float32))
+        sigma = estimate_sigma(k.astype(jnp.bfloat16), u.astype(jnp.bfloat16))
+        assert sigma.dtype == jnp.float32
+
+    def test_spectral_normalize_bf16_kernel_keeps_dtype(self, rng):
+        class SN(nn.Module):
+            @nn.compact
+            def __call__(self, training=False):
+                k = self.param(
+                    "kernel", nn.initializers.normal(1.0), (3, 3, 4, 8))
+                return spectral_normalize(
+                    self, k.astype(jnp.bfloat16), training)
+
+        variables = SN().init(jax.random.PRNGKey(0))
+        out = SN().apply(variables, training=False)
+        # no silent promotion back to fp32 downstream of the divide...
+        assert out.dtype == jnp.bfloat16
+        # ...and the stored u vector is an fp32 island
+        assert variables["spectral"]["u"].dtype == jnp.float32
+
+
+class TestNormStatIslands:
+    @pytest.mark.parametrize("norm_cls", [InstanceNorm, LayerNorm2d])
+    def test_bf16_in_bf16_out_fp32_stats(self, rng, norm_cls):
+        x = jnp.asarray(rng.randn(2, 8, 8, 4).astype(np.float32))
+        mod = norm_cls()
+        variables = mod.init(jax.random.PRNGKey(0), x)
+        out16 = mod.apply(variables, x.astype(jnp.bfloat16))
+        assert out16.dtype == jnp.bfloat16
+        out32 = mod.apply(variables, x)
+        assert out32.dtype == jnp.float32
+        # same statistics path: bf16 output is the rounded fp32 result
+        np.testing.assert_allclose(np.asarray(out16, np.float32),
+                                   np.asarray(out32), atol=4e-2)
+
+
+class TestAuditNormIsland:
+    def test_tree_norm_accumulates_fp32(self, rng):
+        from imaginaire_tpu.diagnostics.audit import tree_norm
+
+        leaves = {"a": jnp.asarray(rng.randn(64).astype(np.float32)),
+                  "b": jnp.asarray(rng.randn(32).astype(np.float32))}
+        want = float(tree_norm(leaves))
+        got = tree_norm(jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), leaves))
+        assert got.dtype == jnp.float32
+        np.testing.assert_allclose(float(got), want, rtol=1e-2)
+
+
+class TestTrainerResolution:
+    def _trainer(self, mutate):
+        cfg = Config(CFG_PATH)
+        cfg.trainer.perceptual_loss.allow_random_init = True
+        mutate(cfg)
+        from imaginaire_tpu.registry import resolve
+
+        return resolve(cfg.trainer.type, "Trainer")(cfg)
+
+    def test_structured_knob_wins(self):
+        def mutate(cfg):
+            cfg.trainer.compute_dtype = "float32"  # legacy scalar loses
+            cfg.trainer.mixed_precision = {"enabled": True,
+                                           "compute_dtype": "bfloat16"}
+
+        t = self._trainer(mutate)
+        assert t.compute_dtype == jnp.bfloat16
+        assert t.mixed_precision is True
+
+    def test_disabled_falls_back_to_legacy_scalar(self):
+        def mutate(cfg):
+            cfg.trainer.compute_dtype = "bfloat16"
+            cfg.trainer.mixed_precision = {"enabled": False}
+
+        t = self._trainer(mutate)
+        assert t.compute_dtype == jnp.bfloat16
+
+        t = self._trainer(lambda cfg: None)  # seed default: fp32 end to end
+        assert t.compute_dtype == jnp.float32
+        assert t.mixed_precision is False

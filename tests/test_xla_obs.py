@@ -347,6 +347,26 @@ def test_gate_passes_clean_run_and_mem_budget_breach_fails(obs_sandbox):
                         mem_budget_frac=0.9) == []
 
 
+def test_gate_max_temp_frac_reads_mem_budget_meta(obs_sandbox):
+    """--max-temp-frac (ISSUE 10): the worst executable's temp
+    allocation as a fraction of bytes_limit, from the ledger's
+    mem_budget meta — the static gate on remat/precision regressions."""
+    s = summarize(_jsonl_events(
+        {"kind": "meta", "name": "mem_budget", "t": 1.0,
+         "bytes_limit": 16e9,
+         "executables": {
+             "gen_step": {"temp_bytes": 12e9, "total_bytes": 13e9},
+             "dis_step": {"temp_bytes": 4e9, "total_bytes": 5e9}}}))
+    fails = check_health(s, max_temp_frac=0.5)
+    assert any("gen_step" in f and "temp" in f for f in fails), fails
+    assert check_health(s, max_temp_frac=0.8) == []
+    # no bytes_limit recorded (CPU run, observability off) -> no-op
+    s2 = summarize(_jsonl_events(
+        {"kind": "meta", "name": "mem_budget", "t": 1.0,
+         "executables": {"gen_step": {"temp_bytes": 12e9}}}))
+    assert check_health(s2, max_temp_frac=0.1) == []
+
+
 def test_check_run_health_cli_max_recompiles(obs_sandbox, tmp_path):
     """CLI legs: --max-recompiles 0 passes a clean jsonl and fails an
     injected-recompile jsonl (the dryrun acceptance pair)."""
