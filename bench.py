@@ -174,7 +174,11 @@ def _xla_mark():
 
 def _xla_leg(mark):
     """{compiles, compile_s, recompile_count, cache_hits,
-    peak_hbm_bytes} for one leg (peak_hbm_bytes is None on CPU)."""
+    peak_hbm_bytes, graph_violations, dead_donations, collective_bytes}
+    for one leg (peak_hbm_bytes is None on CPU). The graph-audit triple
+    is the static verdict over the leg's fresh compiles — a bench leg
+    that introduces a dead donated arg or an island cast shows it here
+    even when its timings look fine."""
     from imaginaire_tpu.telemetry import xla_obs
 
     delta = xla_obs.snapshot_delta(mark)

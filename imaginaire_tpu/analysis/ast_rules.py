@@ -1,0 +1,304 @@
+"""Repo-specific AST lint rules (the source half of the graph auditor).
+
+Rules (names are what goes in allowlist comments):
+
+- ``bare-jit``               — no ``jax.jit`` outside
+                               ``telemetry/xla_obs.py``: every compiled
+                               program must be a ledgered
+                               ``xla_obs.compiled_program`` so the
+                               recompile tripwire and the graph audit
+                               see it
+- ``host-sync``              — no ``jax.device_get`` /
+                               ``block_until_ready`` in step-path
+                               modules (trainers/models/layers/losses/
+                               ops/flow/optim/parallel/diagnostics);
+                               host syncs there stall the dispatch
+                               pipeline every iteration
+- ``untimed-barrier``        — no direct ``jax.experimental.
+                               multihost_utils`` use outside the timed
+                               wrappers in ``parallel/collectives.py`` /
+                               ``resilience/``; a raw barrier hangs the
+                               pod forever when one host dies
+- ``numpy-random``           — no ``numpy.random`` inside traced-code
+                               modules (models/layers/losses/ops/flow):
+                               host RNG inside a traced fn bakes one
+                               sample into the executable forever
+- ``mutable-default-pytree`` — no mutable default (list/dict/set
+                               literal or constructor) on
+                               flax-module/dataclass fields: the
+                               default is shared across instances and
+                               silently couples modules
+
+Allowlist syntax (inline, same line or the line above)::
+
+    some_call()  # lint: allow(host-sync) -- reason the reader needs
+
+The reason string is MANDATORY — an allowlist entry without one is
+itself a violation (``allowlist-reason``). Zero silent suppressions.
+"""
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+
+RULE_NAMES = ("bare-jit", "host-sync", "untimed-barrier", "numpy-random",
+              "mutable-default-pytree")
+
+# ``# lint: allow(rule[, rule]) -- reason``  (also accepts — or - )
+ALLOW_RE = re.compile(
+    r"#\s*lint:\s*allow\(([A-Za-z0-9_\-, ]+)\)"
+    r"(?:\s*(?:--|—|-)\s*(\S.*))?")
+
+# module scopes, as path fragments relative to the repo root
+STEP_PATH_PREFIXES = tuple(
+    f"imaginaire_tpu/{m}/" for m in
+    ("trainers", "models", "layers", "losses", "ops", "flow", "optim",
+     "parallel", "diagnostics"))
+TRACED_CODE_PREFIXES = tuple(
+    f"imaginaire_tpu/{m}/" for m in
+    ("models", "layers", "losses", "ops", "flow"))
+BARRIER_HOME = ("imaginaire_tpu/parallel/collectives.py",
+                "imaginaire_tpu/resilience/")
+JIT_HOME = ("imaginaire_tpu/telemetry/xla_obs.py",)
+
+
+@dataclass
+class LintViolation:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def as_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+@dataclass
+class Suppression:
+    rule: str
+    path: str
+    line: int
+    reason: str
+
+
+def _relpath(path, root=None):
+    root = root or os.getcwd()
+    try:
+        rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    except ValueError:
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def _dotted(node):
+    """'jax.experimental.multihost_utils.sync_global_devices' for an
+    Attribute/Name chain, or None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _RuleVisitor(ast.NodeVisitor):
+    def __init__(self, rel, jit_aliases):
+        self.rel = rel
+        self.jit_aliases = jit_aliases
+        self.found = []
+
+    def add(self, rule, node, message):
+        self.found.append(LintViolation(rule, self.rel,
+                                        getattr(node, "lineno", 0),
+                                        message))
+
+    # ------------------------------------------------------ bare-jit
+    def _is_jit(self, node):
+        dotted = _dotted(node)
+        if dotted is None:
+            return False
+        return dotted in self.jit_aliases or dotted.endswith("jax.jit")
+
+    def _check_jit(self, node):
+        if self.rel in JIT_HOME or self.rel.startswith("tests/"):
+            return
+        if self._is_jit(node):
+            self.add("bare-jit", node,
+                     "bare jax.jit — route through xla_obs."
+                     "compiled_program so the ledger, recompile "
+                     "tripwire and graph audit cover this program")
+
+    # --------------------------------------------------------- visits
+    def visit_Call(self, node):
+        self._check_jit(node.func)
+        dotted = _dotted(node.func) or ""
+        tail = dotted.rsplit(".", 1)[-1]
+        if tail in ("device_get", "block_until_ready") \
+                and self.rel.startswith(STEP_PATH_PREFIXES):
+            self.add("host-sync", node,
+                     f"{tail} in a step-path module forces a host sync "
+                     f"on the dispatch path")
+        if "multihost_utils" in dotted \
+                and not self.rel.startswith(BARRIER_HOME):
+            self.add("untimed-barrier", node,
+                     f"direct multihost_utils call ({dotted}) — use the "
+                     f"timed wrappers in parallel/collectives.py")
+        if (".random." in dotted + "." or dotted.startswith("random.")) \
+                and dotted.split(".")[0] in ("np", "numpy") \
+                and self.rel.startswith(TRACED_CODE_PREFIXES):
+            self.add("numpy-random", node,
+                     f"{dotted} in traced-code module: host RNG inside "
+                     f"a traced fn bakes one sample into the "
+                     f"executable")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        # method spelling: x.block_until_ready()
+        if node.attr == "block_until_ready" \
+                and self.rel.startswith(STEP_PATH_PREFIXES):
+            self.add("host-sync", node,
+                     "block_until_ready in a step-path module forces a "
+                     "host sync on the dispatch path")
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        for deco in node.decorator_list:
+            target = deco.func if isinstance(deco, ast.Call) else deco
+            self._check_jit(target)
+            # functools.partial(jax.jit, ...) decorators
+            if isinstance(deco, ast.Call):
+                for arg in deco.args:
+                    self._check_jit(arg)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        is_module = any("Module" in (_dotted(b) or "") for b in node.bases)
+        is_dc = any("dataclass" in (_dotted(
+            d.func if isinstance(d, ast.Call) else d) or "")
+            for d in node.decorator_list)
+        if is_module or is_dc:
+            for stmt in node.body:
+                value = None
+                if isinstance(stmt, ast.AnnAssign):
+                    value = stmt.value
+                elif isinstance(stmt, ast.Assign):
+                    value = stmt.value
+                if value is not None and _is_mutable_literal(value):
+                    self.add("mutable-default-pytree", stmt,
+                             f"mutable default on a "
+                             f"{'flax-module' if is_module else 'dataclass'}"
+                             f" field in {node.name}: shared across "
+                             f"instances — use a factory/None sentinel")
+        self.generic_visit(node)
+
+
+def _is_mutable_literal(node):
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("list", "dict", "set") and not node.args \
+            and not node.keywords:
+        return True
+    return False
+
+
+def _jit_aliases(tree):
+    """Local names that are jax.jit (``from jax import jit [as j]``)."""
+    aliases = {"jax.jit"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "jit":
+                    aliases.add(alias.asname or alias.name)
+    return aliases
+
+
+def _collect_allows(src):
+    allows = {}
+    for lineno, line in enumerate(src.splitlines(), 1):
+        match = ALLOW_RE.search(line)
+        if match:
+            rules = {r.strip() for r in match.group(1).split(",")
+                     if r.strip()}
+            reason = (match.group(2) or "").strip() or None
+            allows[lineno] = (rules, reason)
+    return allows
+
+
+def lint_source(src, rel):
+    """(violations, suppressions) for one file's source text."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [LintViolation("syntax", rel, e.lineno or 0, str(e))], []
+    visitor = _RuleVisitor(rel, _jit_aliases(tree))
+    visitor.visit(tree)
+    allows = _collect_allows(src)
+    violations, suppressions = [], []
+    flagged = set()
+    seen = set()
+    found = []
+    for v in visitor.found:  # method+call spellings can double-report
+        key = (v.rule, v.line)
+        if key not in seen:
+            seen.add(key)
+            found.append(v)
+    for v in found:
+        handled = False
+        for lineno in (v.line, v.line - 1):
+            entry = allows.get(lineno)
+            if entry and v.rule in entry[0]:
+                rules, reason = entry
+                if reason is None:
+                    if (rel, lineno) not in flagged:
+                        flagged.add((rel, lineno))
+                        violations.append(LintViolation(
+                            "allowlist-reason", rel, lineno,
+                            f"allowlist entry for {sorted(rules)} has no "
+                            f"reason string — `# lint: allow(rule) -- "
+                            f"why` (zero silent suppressions)"))
+                else:
+                    suppressions.append(
+                        Suppression(v.rule, rel, v.line, reason))
+                handled = True
+                break
+        if not handled:
+            violations.append(v)
+    return violations, suppressions
+
+
+def lint_file(path, root=None):
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, _relpath(path, root))
+
+
+def iter_repo_files(root):
+    """Every lintable .py under the repo: the package, scripts/, and
+    the top-level entry points (tests are exercised, not linted)."""
+    for base in ("imaginaire_tpu", "scripts"):
+        top = os.path.join(root, base)
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+    for name in sorted(os.listdir(root)):
+        if name.endswith(".py"):
+            yield os.path.join(root, name)
+
+
+def lint_repo(root):
+    """(violations, suppressions) across the whole repo."""
+    violations, suppressions = [], []
+    for path in iter_repo_files(root):
+        v, s = lint_file(path, root)
+        violations.extend(v)
+        suppressions.extend(s)
+    return violations, suppressions

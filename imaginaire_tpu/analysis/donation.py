@@ -1,0 +1,102 @@
+"""Donation analysis: name every declared-but-dead donated argument.
+
+A donated buffer only helps when XLA actually aliases it to an output
+(``input_output_alias`` in the compiled module). Two ways a declared
+donation dies silently:
+
+- the argument is DCE'd out of the program entirely (a state leaf the
+  step never reads) — it never reaches the executable, so the donation
+  is a no-op and the caller still loses the buffer;
+- the argument is kept but no output matches its shape/layout, so XLA
+  cannot alias it (e.g. a reshaped return) and quietly copies instead.
+
+Both cases waste HBM exactly where the activation wall bites. This
+module cross-references three artifacts, all public or degradable:
+
+- ``compiled.args_info``: the full *pre-DCE* input pytree with
+  ``.donated`` flags — gives every donated leaf a tree path;
+- the kept-argument set: ``lowered._lowering.compile_args
+  ["kept_var_idx"]`` when available (private — guarded), otherwise
+  estimated from which top-level jaxpr invars any equation reads;
+- the compiled HLO's ``input_output_alias`` map (hlo_audit), whose
+  parameter numbering is over the kept arguments in order.
+"""
+
+import jax
+
+from . import hlo_audit
+from .jaxpr_audit import Violation, _as_jaxpr
+
+
+def flat_args_info(args_info):
+    """[(flat_index, path_str, donated)] over the pre-DCE input tree."""
+    leaves = jax.tree_util.tree_flatten_with_path(args_info)[0]
+    out = []
+    for i, (path, info) in enumerate(leaves):
+        out.append((i, jax.tree_util.keystr(path),
+                    bool(getattr(info, "donated", False))))
+    return out
+
+
+def kept_indices(lowered, closed_jaxpr, n_args):
+    """Flat indices of arguments that survive DCE. Prefers the
+    lowering's own ``kept_var_idx``; falls back to scanning the
+    top-level jaxpr for invars any equation (or the output) reads."""
+    try:
+        kept = lowered._lowering.compile_args["kept_var_idx"]  # noqa: SLF001
+        return set(int(i) for i in kept)
+    except Exception:  # noqa: BLE001 — private API; estimate instead
+        pass
+    jaxpr = _as_jaxpr(closed_jaxpr)
+    if jaxpr is None:
+        return set(range(n_args))
+    used = set()
+    for eqn in jaxpr.eqns:
+        for var in eqn.invars:
+            used.add(id(var))
+    for var in jaxpr.outvars:
+        used.add(id(var))
+    return {i for i, var in enumerate(jaxpr.invars) if id(var) in used}
+
+
+def audit_donation(program, compiled, closed_jaxpr=None, lowered=None,
+                   hlo_text=None):
+    """Returns (violations, summary). Summary:
+    ``{declared, aliased, dead_count, dead: [{path, reason}]}``; one
+    ``dead_donation`` violation per dead arg, named by its tree path."""
+    args_info = getattr(compiled, "args_info", None)
+    if args_info is None:
+        return [], {"declared": 0, "aliased": 0, "dead_count": 0,
+                    "dead": [], "error": "no args_info"}
+    flat = flat_args_info(args_info)
+    donated = [(i, path) for i, path, d in flat if d]
+    summary = {"declared": len(donated), "aliased": 0, "dead": []}
+    if not donated:
+        summary["dead_count"] = 0
+        return [], summary
+    kept = kept_indices(lowered, closed_jaxpr, len(flat))
+    if hlo_text is None:
+        try:
+            hlo_text = compiled.as_text()
+        except Exception:  # noqa: BLE001 — text dump is best-effort
+            hlo_text = ""
+    aliased_params = hlo_audit.aliased_param_indices(hlo_text)
+    kept_order = sorted(kept)
+    violations = []
+    for i, path in donated:
+        if i not in kept:
+            reason = ("argument is dead code — DCE removed it, the "
+                      "donated buffer is still lost to the caller")
+        else:
+            param_idx = kept_order.index(i)
+            if param_idx in aliased_params:
+                summary["aliased"] += 1
+                continue
+            reason = ("no output aliases this buffer (shape/layout "
+                      "mismatch or unused result) — XLA copies instead")
+        summary["dead"].append({"path": path, "reason": reason})
+        violations.append(Violation(
+            "dead_donation", program, f"args{path}",
+            f"donated argument {path} is dead: {reason}"))
+    summary["dead_count"] = len(summary["dead"])
+    return violations, summary

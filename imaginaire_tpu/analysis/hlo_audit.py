@@ -1,0 +1,128 @@
+"""Compiled-HLO text analysis: the post-partitioner half of the audit.
+
+The jaxpr shows what the program *asked for*; the optimized HLO shows
+what XLA actually emits after SPMD partitioning — collectives inserted
+for sharded params never appear at the jaxpr level. This module parses
+``compiled.as_text()`` (no private APIs) for:
+
+- collective ops + payload bytes  -> collectives.py accounting
+- the entry ``input_output_alias`` map -> donation.py dead-arg analysis
+- host-callback custom-calls      -> backstop for callbacks that lower
+                                     through ``custom-call`` targets
+- an f64 op count                 -> cross-check of the jaxpr rule
+"""
+
+import re
+
+from .jaxpr_audit import Violation
+
+# optimized-HLO collective op mnemonics (all fusions keep these names)
+HLO_COLLECTIVE_OPS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast",
+)
+
+# python host-callback custom-call targets across jax versions
+_HOST_CALLBACK_TARGETS = (
+    "xla_python_cpu_callback", "xla_ffi_python_cpu_callback",
+    "xla_python_gpu_callback", "xla_ffi_python_gpu_callback",
+    "tpu_python_callback",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+for _f8 in ("f8e4m3fn", "f8e5m2", "f8e4m3b11fnuz", "f8e4m3fnuz",
+            "f8e5m2fnuz", "f8e3m4", "f8e4m3"):
+    _DTYPE_BYTES[_f8] = 1
+
+_SHAPE_RE = re.compile(
+    r"\b(" + "|".join(sorted(_DTYPE_BYTES, key=len, reverse=True))
+    + r")\[([0-9,]*)\]")
+# one alias-map entry: `{out_index}: (param, {param_index_path}, kind)`
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{[\d,\s]*\}:\s*\((\d+)\s*,\s*\{[\d,\s]*\}\s*,\s*"
+    r"(?:may-alias|must-alias)\)")
+# instruction rhs: `shape op(operands...)` — the result shape (a typed
+# array literal or a tuple of them) precedes the op mnemonic
+_INSTR_RE = re.compile(
+    r"^\s*(?P<shape>\([^=]*?\)|[a-z0-9]+\[[0-9,]*\](?:\{[^}]*\})?)\s+"
+    r"(?P<op>[a-z][\w-]*)\(")
+
+
+def shape_bytes(text):
+    """Total bytes of every typed shape literal in ``text``
+    (``f32[8,128]`` -> 4096; tuple shapes sum their elements)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def collective_stats(hlo_text):
+    """op -> {count, bytes} over the optimized HLO. Bytes are the
+    result-shape payload of each collective instruction (start/done
+    pairs of async collectives count once, on the -start; the -start's
+    tuple shape bounds the payload)."""
+    stats = {}
+    for line in hlo_text.splitlines():
+        if "=" not in line:
+            continue
+        _, _, rhs = line.partition("=")
+        m = _INSTR_RE.match(rhs)
+        if m is None:
+            continue
+        op = m.group("op")
+        if op.endswith("-done"):
+            continue
+        if op.endswith("-start"):
+            op = op[:-len("-start")]
+        if op not in HLO_COLLECTIVE_OPS:
+            continue
+        entry = stats.setdefault(op, {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += shape_bytes(m.group("shape"))
+    return stats
+
+
+def aliased_param_indices(hlo_text):
+    """Parameter numbers named in the entry ``input_output_alias`` map
+    (numbering is over the DCE-kept parameters)."""
+    marker = hlo_text.find("input_output_alias=")
+    if marker < 0:
+        return set()
+    # the map lives on the HloModule header line
+    line_end = hlo_text.find("\n", marker)
+    segment = hlo_text[marker:line_end if line_end > 0 else None]
+    return {int(m) for m in _ALIAS_ENTRY_RE.findall(segment)}
+
+
+def audit_hlo(program, hlo_text):
+    """Returns (violations, stats): host-callback custom-call backstop
+    violations plus {collectives, collective_op_count, collective_bytes,
+    f64_ops, aliased_params}."""
+    violations = []
+    stats = {}
+    collectives = collective_stats(hlo_text)
+    stats["collectives"] = collectives
+    stats["collective_op_count"] = sum(
+        v["count"] for v in collectives.values())
+    stats["collective_bytes"] = sum(
+        v["bytes"] for v in collectives.values())
+    stats["f64_ops"] = hlo_text.count("f64[")
+    stats["aliased_params"] = sorted(aliased_param_indices(hlo_text))
+    for target in _HOST_CALLBACK_TARGETS:
+        count = hlo_text.count(f'custom_call_target="{target}"')
+        if count:
+            violations.append(Violation(
+                "host_callback", program, f'custom-call:"{target}"',
+                f"{count} host-callback custom-call(s) survived to the "
+                f"optimized HLO"))
+    return violations, stats

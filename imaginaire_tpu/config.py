@@ -265,6 +265,21 @@ def default_config():
             # the configured cache. Tripping emits an
             # xla/persistent_cache_disabled meta event.
             persistent_cache="off_on_resume",  # on | off | off_on_resume
+            # Graph audit (imaginaire_tpu/analysis, ISSUE 12): every
+            # ledgered compile statically checks its closed jaxpr + the
+            # optimized HLO (host callbacks, f64 leaks, bf16 casts
+            # inside declared fp32 islands, oversized baked constants,
+            # dead donated args, per-program collective bytes). The
+            # verdict rides the ledger entry ('audit'), feeds the
+            # xla/graph/<label>/* counters and the report's graph-audit
+            # section, and gates via check_run_health
+            # --max-graph-violations. audit_hlo=False skips the HLO
+            # text pass (collectives/donation) when as_text() is too
+            # slow for a huge program; audit_const_bytes is the
+            # baked_constant threshold.
+            graph_audit=True,
+            audit_hlo=True,
+            audit_const_bytes=4194304,  # 4 MiB
         ),
         # -- training-health diagnostics (diagnostics/): in-step norm
         # auditing (per-module grad/param norms, update/param ratio,

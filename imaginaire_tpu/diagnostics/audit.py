@@ -22,16 +22,19 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from imaginaire_tpu.analysis import islands
+
 
 def tree_norm(tree):
-    """Global L2 norm of a pytree, accumulated in fp32. Leaves are
+    """Global L2 norm of a pytree, accumulated in fp32 — the
+    ``loss_accumulation`` island (analysis/islands.py). Leaves are
     upcast BEFORE the sum-of-squares — casting the finished norm would
     let a bf16 tree accumulate (and overflow/round) in bf16 first."""
     tree32 = jax.tree_util.tree_map(
         lambda x: jnp.asarray(x).astype(jnp.float32), tree)
-    norm = optax.global_norm(tree32)
-    assert norm.dtype == jnp.float32, (
-        f"health-audit norms must stay float32, got {norm.dtype}")
+    with islands.scope("loss_accumulation"):
+        norm = optax.global_norm(tree32)
+        islands.guard("loss_accumulation", norm=norm)
     return norm
 
 

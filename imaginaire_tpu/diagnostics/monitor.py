@@ -155,6 +155,7 @@ class HealthMonitor:
 
     def _check(self, trainer, entry):
         h = entry["health"]
+        # lint: allow(host-sync) -- reads the PREVIOUS step's flags, one step behind the dispatch frontier
         finite, audited = (bool(x) for x in jax.device_get(
             (h["finite"], h["audited"])))
         if audited:
@@ -175,8 +176,10 @@ class HealthMonitor:
         metrics = {k: v for k, v in entry["health"].items()
                    if k not in _CONTROL_KEYS}
         health = {k: float(v) for k, v in
+                  # lint: allow(host-sync) -- completed-step transfer
                   jax.device_get(metrics).items()}
         lvals = {k: float(v) for k, v in
+                 # lint: allow(host-sync) -- completed-step transfer
                  jax.device_get(dict(entry["losses"])).items()}
         tm = telemetry.get()
         for name, value in health.items():

@@ -49,6 +49,7 @@ def _finite(x):
 
 def _float(x):
     try:
+        # lint: allow(host-sync) -- post-mortem triage, training halted
         return float(jax.device_get(x))
     except Exception:  # noqa: BLE001 — a fetch failure must not kill triage
         return float("nan")
@@ -58,6 +59,7 @@ def _triage_rng(trainer, entry):
     """Reconstruct the per-step RNG key the bad program folded in
     (``health['rng_step']`` recorded the pre-increment counter)."""
     stream = trainer.state["rng_G" if entry["kind"] == "G" else "rng_D"]
+    # lint: allow(host-sync) -- post-mortem triage, training halted
     rng_step = int(jax.device_get(entry["health"]["rng_step"]))
     return jax.random.fold_in(stream, rng_step), rng_step
 
@@ -122,6 +124,7 @@ def batch_stats(data):
             continue
         name = jax.tree_util.keystr(path)
         try:
+            # lint: allow(host-sync) -- post-mortem dump, training halted
             arr = np.asarray(jax.device_get(leaf))
         except Exception:  # noqa: BLE001
             continue

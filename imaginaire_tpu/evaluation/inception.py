@@ -206,14 +206,25 @@ def make_extractor(variables, compute_dtype=jnp.bfloat16):
     Compiles through the ledger (``telemetry/xla_obs.py``) so FID/KID
     sweeps account their compile time and executable footprint like the
     step programs; allow_shape_growth — the tail batch of a sweep is
-    legitimately smaller."""
+    legitimately smaller.
+
+    The weights are a program *argument*, not a closure: closed-over
+    params would be baked into the executable as ~87 MB of constants
+    (the graph auditor's ``baked_constant`` rule), pinned for the
+    executable's lifetime on top of the live copy."""
     from imaginaire_tpu.telemetry import xla_obs
 
     model = InceptionV3()
 
-    def run(images):
+    def run(variables, images):
         feats = model.apply(variables, images.astype(compute_dtype))
         return feats.astype(jnp.float32)
 
-    return xla_obs.compiled_program("inception_extractor", run,
-                                    allow_shape_growth=True)
+    program = xla_obs.compiled_program("inception_extractor", run,
+                                       allow_shape_growth=True)
+
+    def extractor(images):
+        return program(variables, images)
+
+    extractor.program = program  # audit/ledger surface
+    return extractor

@@ -18,6 +18,7 @@ from typing import Any
 import jax.numpy as jnp
 from flax import linen as nn
 
+from imaginaire_tpu.analysis import islands
 from imaginaire_tpu.layers import hyper_ops
 
 
@@ -50,13 +51,16 @@ class InstanceNorm(nn.Module):
     @nn.compact
     def __call__(self, x, *cond, training=False):
         axes = tuple(range(1, x.ndim - 1))
-        # statistics in fp32 even under a bf16 compute policy
+        # statistics in fp32 even under a bf16 compute policy: the
+        # `norm_stats` island (analysis/islands.py) — the exit cast back
+        # to x.dtype stays OUTSIDE the scope
         x32 = x.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=axes, keepdims=True)
-        var = jnp.var(x32, axis=axes, keepdims=True)
-        assert mean.dtype == jnp.float32, (
-            f"InstanceNorm statistics must stay float32, got {mean.dtype}")
-        y = ((x32 - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))).astype(x.dtype)
+        with islands.scope("norm_stats"):
+            mean = jnp.mean(x32, axis=axes, keepdims=True)
+            var = jnp.var(x32, axis=axes, keepdims=True)
+            islands.guard("norm_stats", mean=mean, var=var)
+            y32 = (x32 - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))
+        y = y32.astype(x.dtype)
         if self.affine:
             c = x.shape[-1]
             scale = self.param("scale", nn.initializers.ones, (c,))
@@ -106,12 +110,15 @@ class LayerNorm2d(nn.Module):
     @nn.compact
     def __call__(self, x, *cond, training=False):
         axes = tuple(range(1, x.ndim))
+        # `norm_stats` fp32 island — exit cast outside the scope
         x32 = x.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=axes, keepdims=True)
-        std = jnp.sqrt(jnp.var(x32, axis=axes, keepdims=True) + self.eps)
-        assert mean.dtype == jnp.float32, (
-            f"LayerNorm2d statistics must stay float32, got {mean.dtype}")
-        y = ((x32 - mean) / std).astype(x.dtype)
+        with islands.scope("norm_stats"):
+            mean = jnp.mean(x32, axis=axes, keepdims=True)
+            std = jnp.sqrt(jnp.var(x32, axis=axes, keepdims=True)
+                           + self.eps)
+            islands.guard("norm_stats", mean=mean, std=std)
+            y32 = (x32 - mean) / std
+        y = y32.astype(x.dtype)
         if self.affine:
             c = x.shape[-1]
             gamma = self.param("gamma", nn.initializers.ones, (c,))

@@ -17,6 +17,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from imaginaire_tpu.analysis import islands
+
 
 def _l2_normalize(v, eps=1e-12):
     return v / (jnp.linalg.norm(v) + eps)
@@ -26,21 +28,22 @@ def power_iteration(w_mat, u, n_steps=1, eps=1e-12):
     """One (or more) power-iteration steps. w_mat: (out, rest), u: (out,).
 
     Returns (sigma, new_u). Gradients do not flow through u/v (matching
-    torch.nn.utils.spectral_norm's no_grad update). The iteration is an
-    fp32 island: a bf16 compute policy hands in a bf16 w_mat, but the
-    normalize/matvec chain runs — and sigma and u come back — in fp32
-    (sigma is a ratio of near-equal quantities; bf16's 8 mantissa bits
-    visibly bias it, and a drifting low-precision u never converges)."""
-    assert u.dtype == jnp.float32, (
-        f"spectral-norm u must stay float32, got {u.dtype}")
-    w_ng = lax.stop_gradient(w_mat).astype(jnp.float32)
-    v = None
-    for _ in range(n_steps):
-        v = _l2_normalize(w_ng.T @ u, eps)
-        u = _l2_normalize(w_ng @ v, eps)
-    u = lax.stop_gradient(u)
-    v = lax.stop_gradient(v)
-    sigma = jnp.einsum("o,or,r->", u, w_mat.astype(jnp.float32), v)
+    torch.nn.utils.spectral_norm's no_grad update). The iteration is the
+    ``sn_power_iteration`` fp32 island (analysis/islands.py): a bf16
+    compute policy hands in a bf16 w_mat, but the normalize/matvec chain
+    runs — and sigma and u come back — in fp32 (sigma is a ratio of
+    near-equal quantities; bf16's 8 mantissa bits visibly bias it, and a
+    drifting low-precision u never converges)."""
+    islands.guard("sn_power_iteration", u=u)
+    with islands.scope("sn_power_iteration"):
+        w_ng = lax.stop_gradient(w_mat).astype(jnp.float32)
+        v = None
+        for _ in range(n_steps):
+            v = _l2_normalize(w_ng.T @ u, eps)
+            u = _l2_normalize(w_ng @ v, eps)
+        u = lax.stop_gradient(u)
+        v = lax.stop_gradient(v)
+        sigma = jnp.einsum("o,or,r->", u, w_mat.astype(jnp.float32), v)
     return sigma, u
 
 
@@ -51,10 +54,11 @@ def estimate_sigma(kernel, u, eps=1e-12):
     exclusive job of ``spectral_normalize``). Same (out, rest) matrix
     view as ``power_iteration`` so tracked sigmas agree with the ones
     the normalization divides by."""
-    w_mat = kernel.reshape(-1, kernel.shape[-1]).T.astype(jnp.float32)
-    u = u.astype(jnp.float32)
-    v = _l2_normalize(w_mat.T @ u, eps)
-    return jnp.einsum("o,or,r->", u, w_mat, v)
+    with islands.scope("sn_power_iteration"):
+        w_mat = kernel.reshape(-1, kernel.shape[-1]).T.astype(jnp.float32)
+        u = u.astype(jnp.float32)
+        v = _l2_normalize(w_mat.T @ u, eps)
+        return jnp.einsum("o,or,r->", u, w_mat, v)
 
 
 def spectral_normalize(module, kernel, training, name="u", n_steps=1, eps=1e-12):

@@ -70,7 +70,7 @@ def _kernel(w, n_dx, stride2, inv_c, x1_ref, x2s_ref, o_ref, acc_ref):
         o_ref[...] = acc_ref[...].astype(o_ref.dtype)
 
 
-@functools.partial(
+@functools.partial(  # lint: allow(bare-jit) -- static-argnames micro-kernel; ops/correlation.py's step programs are ledgered
     jax.jit, static_argnames=("pad_size", "kernel_size", "max_displacement", "stride2", "interpret")
 )
 def correlation_pallas(x1, x2, pad_size=20, kernel_size=1, max_displacement=20, stride2=2, interpret=False):

@@ -82,6 +82,7 @@ def _kernel(w, h, c, band, x_ref, flow_ref, o_ref):
     lax.fori_loop(0, band * w, body, 0)
 
 
+# lint: allow(bare-jit) -- static-argnames micro-kernel; ops/resample2d.py's step programs are ledgered
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def resample2d_fwd_pallas(x, flow, interpret=False):
     """Public NHWC contract; channels-first inside (see module doc)."""

@@ -150,6 +150,7 @@ def init_optimizer_state(tx, params, plan=None):
     shardings = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda s: type(s).__name__ == "PartitionSpec")
+    # lint: allow(bare-jit) -- one-shot sharded optimizer-state init at t=0; out_shardings placement, never re-dispatched
     return jax.jit(tx.init, out_shardings=shardings)(params)
 
 

@@ -57,6 +57,7 @@ def summarize(events):
     retry_exhausted = []
     desync_events = []
     consensus_events = []
+    graph_events = []
     meta = {}
     hangs = []
     t_min = t_max = None
@@ -112,6 +113,8 @@ def summarize(events):
                 desync_events.append(ev)
             elif name == "resilience/consensus_resume":
                 consensus_events.append(ev)
+            elif name == "graph_violation":
+                graph_events.append(ev)
             elif str(name).startswith("chaos/"):
                 chaos_events.append(ev)
             meta[ev.get("name", "?")] = ev
@@ -224,10 +227,35 @@ def summarize(events):
         "desync_events": desync_events,
         "consensus_events": consensus_events,
     }
+    # graph audit (ISSUE 12): per-program static-analysis verdicts from
+    # the compile ledger (xla/graph/<label>/* counters hold the LATEST
+    # audit per program; xla/graph_violations is the cross-program sum)
+    graph_programs = {}
+    for name, (value, _) in counters.items():
+        m = str(name)
+        if not m.startswith("xla/graph/"):
+            continue
+        label, _, key = m[len("xla/graph/"):].rpartition("/")
+        if label and key in ("violations", "dead_donations",
+                             "collective_bytes"):
+            graph_programs.setdefault(label, {})[key] = int(value or 0)
+    graph = {
+        "present": bool(graph_programs)
+        or "xla/graph_violations" in counters,
+        "programs": graph_programs,
+        "violations": int(
+            counters.get("xla/graph_violations", (0, None))[0] or 0)
+        or sum(p.get("violations", 0) for p in graph_programs.values()),
+        "dead_donations": sum(p.get("dead_donations", 0)
+                              for p in graph_programs.values()),
+        "collective_bytes": sum(p.get("collective_bytes", 0)
+                                for p in graph_programs.values()),
+        "violation_events": graph_events,
+    }
     return {"phases": table, "counters": counters, "meta": meta,
             "hangs": hangs, "wall_s": wall_s, "health": health,
             "flow_cache": flow_cache, "xla": xla,
-            "resilience": resilience}
+            "resilience": resilience, "graph": graph}
 
 
 def _trend(series):
@@ -336,6 +364,32 @@ def _xla_section(s):
     return lines
 
 
+def _graph_section(s):
+    """Markdown lines for the static graph-audit section. Empty when
+    the run carried no xla/graph/* counters (audit disabled)."""
+    g = s.get("graph") or {}
+    if not g.get("present"):
+        return []
+    lines = ["", "## graph audit"]
+    for label in sorted(g.get("programs", {})):
+        row = g["programs"][label]
+        lines.append(
+            f"- {label}: {row.get('violations', 0)} violation(s), "
+            f"{row.get('dead_donations', 0)} dead donation(s), "
+            f"collective bytes "
+            f"{_fmt_bytes(row.get('collective_bytes', 0))}")
+    total = g.get("violations", 0)
+    if total:
+        lines.append(f"!! {total} graph violation(s):")
+        for ev in g.get("violation_events", []):
+            for v in (ev.get("violations") or [])[:8]:
+                lines.append(f"  - {ev.get('label')}: {v.get('rule')} at "
+                             f"{v.get('path')} — {v.get('message')}")
+    else:
+        lines.append("- graph violations: 0")
+    return lines
+
+
 def _resilience_section(s):
     """Markdown lines for the fault-tolerance section. Empty when the
     run carried no resilience events (the common, healthy case)."""
@@ -432,6 +486,7 @@ def render_report(path_or_events):
                      f"{flops_meta.get('peak_source')})")
     lines.extend(_health_section(s))
     lines.extend(_xla_section(s))
+    lines.extend(_graph_section(s))
     lines.extend(_resilience_section(s))
     if s["hangs"]:
         lines.append("")

@@ -101,6 +101,11 @@ class _Settings:
         self.census_top = 20
         self.oom_report = True
         self.logdir = None
+        # graph audit (imaginaire_tpu/analysis): every compile's jaxpr
+        # + HLO are statically checked and the verdict rides the ledger
+        self.graph_audit = True
+        self.audit_hlo = True
+        self.audit_const_bytes = 4 << 20
 
 
 _SETTINGS = _Settings()
@@ -123,6 +128,10 @@ def xla_obs_settings(cfg):
         "mem_budget_frac": float(cfg_get(ocfg, "mem_budget_frac", 0.9)),
         "census_top": int(cfg_get(ocfg, "census_top", 20)),
         "oom_report": bool(cfg_get(ocfg, "oom_report", True)),
+        "graph_audit": bool(cfg_get(ocfg, "graph_audit", True)),
+        "audit_hlo": bool(cfg_get(ocfg, "audit_hlo", True)),
+        "audit_const_bytes": int(cfg_get(ocfg, "audit_const_bytes",
+                                         4 << 20)),
     }
 
 
@@ -326,6 +335,18 @@ class CompileLedger:
             tm.counter(f"xla/compile/{label}/{key}", value)
         tm.meta(f"xla_compile/{label}",
                 **{k: v for k, v in entry.items() if k != "kind"})
+        audit = entry.get("audit") or {}
+        if audit and "error" not in audit:
+            tm.counter(f"xla/graph/{label}/violations",
+                       audit.get("violation_count", 0))
+            tm.counter(f"xla/graph/{label}/dead_donations",
+                       (audit.get("donation") or {}).get("dead_count", 0))
+            tm.counter(f"xla/graph/{label}/collective_bytes",
+                       (audit.get("collectives") or {}).get("bytes", 0))
+            if audit.get("violation_count"):
+                tm.meta("graph_violation", label=label,
+                        count=audit["violation_count"],
+                        violations=audit["violations"][:8])
         if entry.get("counted_recompile"):
             tm.counter("xla/recompiles", self.recompiles)
             tm.meta("xla_recompile", label=label, diff=entry.get("diff"),
@@ -368,12 +389,34 @@ class CompileLedger:
             total = len(self.records)
         tm.counter("xla/recompiles", recompiles, step=step)
         tm.counter("xla/compiles_total", total, step=step)
+        tm.counter("xla/graph_violations", self._graph_totals()[0],
+                   step=step)
         for label, count in hits.items():
             tm.counter(f"xla/compile/{label}/cache_hits", count,
                        step=step)
 
+    def _graph_totals(self):
+        """(violations, dead_donations, collective_bytes) summed over
+        the LATEST audit per label — recompiles of one program replace
+        its verdict instead of double-counting it."""
+        with self._lock:
+            records = list(self.records)
+        latest = {}
+        for record in records:
+            audit = record.get("audit")
+            if audit and "error" not in audit:
+                latest[record["label"]] = audit
+        violations = sum(a.get("violation_count", 0)
+                         for a in latest.values())
+        dead = sum((a.get("donation") or {}).get("dead_count", 0)
+                   for a in latest.values())
+        coll = sum((a.get("collectives") or {}).get("bytes", 0)
+                   for a in latest.values())
+        return violations, dead, coll
+
     def snapshot(self):
         """Cumulative totals for bench-leg deltas."""
+        violations, dead, coll = self._graph_totals()
         with self._lock:
             return {
                 "compiles": len(self.records),
@@ -382,6 +425,9 @@ class CompileLedger:
                     for r in self.records), 3),
                 "recompiles": self.recompiles,
                 "cache_hits": sum(self.cache_hits.values()),
+                "graph_violations": violations,
+                "dead_donations": dead,
+                "collective_bytes": coll,
             }
 
 
@@ -567,7 +613,14 @@ class CompiledProgram:
         _LEDGER.begin(self.label)
         try:
             t0 = time.perf_counter()
-            lowered = self._jit.lower(*args)
+            # trace explicitly so the graph auditor gets the closed
+            # jaxpr the lowering consumed — lower() alone discards it
+            traced = None
+            try:
+                traced = self._jit.trace(*args)
+                lowered = traced.lower()
+            except AttributeError:  # jax without .trace
+                lowered = self._jit.lower(*args)
             t1 = time.perf_counter()
             compiled = lowered.compile()
             t2 = time.perf_counter()
@@ -593,6 +646,9 @@ class CompiledProgram:
         }
         if counted and diff is not None:
             entry["diff"] = diff
+        if _SETTINGS.graph_audit:
+            entry["audit"] = _run_audit(self.label, traced, lowered,
+                                        compiled)
         _LEDGER.record(entry)
         if counted:
             text = _diff_text(diff)
@@ -623,6 +679,28 @@ def compiled_program(label, fn, donate_argnums=(),
     donate_argnums=...)`` at every named compile site."""
     return CompiledProgram(label, fn, donate_argnums=donate_argnums,
                            allow_shape_growth=allow_shape_growth)
+
+
+def _run_audit(label, traced, lowered, compiled):
+    """Graph audit (imaginaire_tpu/analysis) for one fresh compile —
+    strictly best-effort: a broken audit is a ledger note, never a
+    failed program."""
+    try:
+        from imaginaire_tpu import analysis
+
+        audit = analysis.audit_program(
+            label, traced=traced, lowered=lowered, compiled=compiled,
+            const_bytes_limit=_SETTINGS.audit_const_bytes,
+            include_hlo=_SETTINGS.audit_hlo)
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"}
+    if audit.get("violation_count"):
+        logger.warning(
+            "graph audit: %d violation(s) in %s — %s",
+            audit["violation_count"], label,
+            "; ".join(f"{v['rule']} at {v['path']}"
+                      for v in audit["violations"][:4]))
+    return audit
 
 
 def _diff_text(diff):

@@ -188,6 +188,7 @@ class BaseTrainer:
 
         data = self._init_data(numeric_only(data))
         k_g, k_d, k_loss, k_noise, k_rg, k_rd = jax.random.split(key, 6)
+        # lint: allow(bare-jit) -- one-shot flax init at t=0, before the ledger's first step program
         vars_G = jax.jit(lambda rngs, d: self.net_G.init(rngs, d, training=True))(
             {"params": k_g, "noise": k_noise}, data)
         vars_G = dict(vars_G)
@@ -202,6 +203,7 @@ class BaseTrainer:
         }
         if self.net_D is not None:
             fake_out = self._fake_output_for_init(data)
+            # lint: allow(bare-jit) -- one-shot flax init at t=0
             vars_D = dict(jax.jit(
                 lambda rngs, d, f: self.net_D.init(rngs, d, f, training=True))(
                 {"params": k_d, "dropout": k_d}, data, fake_out))
@@ -445,6 +447,7 @@ class BaseTrainer:
         self.diag.observe(self, "G", losses, health, batch,
                           self.current_iteration)
         if self.speed_benchmark:
+            # lint: allow(host-sync) -- speed_benchmark timing fence, opt-in flag only
             jax.block_until_ready(self.state["vars_G"]["params"])
             self._meter("time/gen_step").write(time.time() - t0)
         self._log_losses("gen_update", losses)
@@ -464,6 +467,7 @@ class BaseTrainer:
         self.diag.observe(self, "D", losses, health, batch,
                           self.current_iteration)
         if self.speed_benchmark:
+            # lint: allow(host-sync) -- speed_benchmark timing fence
             jax.block_until_ready(self.state["vars_D"]["params"])
             self._meter("time/dis_step").write(time.time() - t0)
         self._log_losses("dis_update", losses)
@@ -591,6 +595,7 @@ class BaseTrainer:
             tm.step_complete(
                 current_iteration, items=self._batch_items(data),
                 dur_s=self.time_iteration,
+                # lint: allow(host-sync) -- heartbeat fence, runs only at the telemetry flush interval
                 fence=lambda: jax.block_until_ready(self.state))
         cfg = self.cfg
         if current_iteration % cfg_get(cfg, "logging_iter", 100) == 0:
@@ -700,9 +705,12 @@ class BaseTrainer:
                                 ("vars_D", "weights/D")):
             tree = (self.state or {}).get(net_key)
             if tree and tree.get("spectral"):
-                write_weight_stats(prefix,
-                                   jax.device_get(tree["params"]),
-                                   jax.device_get(tree["spectral"]), step)
+                write_weight_stats(
+                    prefix,
+                    # lint: allow(host-sync) -- logging-cadence stat dump
+                    jax.device_get(tree["params"]),
+                    # lint: allow(host-sync) -- logging-cadence stat dump
+                    jax.device_get(tree["spectral"]), step)
 
     # subclass extension points (ref: base.py:481-585)
     def _start_of_epoch(self, current_epoch):
@@ -871,6 +879,7 @@ class BaseTrainer:
             import pickle
 
             with open(path + ".ema_bn.pkl", "wb") as f:
+                # lint: allow(host-sync) -- checkpoint serialization path
                 pickle.dump(jax.device_get(self._ema_batch_stats), f)
         print(f"Save checkpoint to {path}")
         return path

@@ -108,16 +108,23 @@ class Trainer(SPADETrainer):
 
         label_nc = get_paired_input_label_channel_number(self.cfg.data)
         variables = self.inference_params()
+        from imaginaire_tpu.telemetry import xla_obs
 
-        @jax.jit
-        def encode_fn_jit(images, instance_maps):
+        # ledgered (and graph-audited) like every compile site; the
+        # variables ride as an argument so they never bake into the
+        # executable as constants
+        def encode(variables, images, instance_maps):
             return self.net_G.apply(
                 variables, images, instance_maps, training=False,
                 method=lambda mdl, im, inst, training: mdl.encoder(
                     im, inst, training=training))
 
+        encode_program = xla_obs.compiled_program(
+            "pix2pixHD_encode", encode, allow_shape_growth=True)
+
         def encode_fn(data):
-            return encode_fn_jit(data["images"], data["instance_maps"])
+            return encode_program(variables, data["images"],
+                                  data["instance_maps"])
 
         preprocessed = (self._init_data(dict(d)) for d in self.val_data_loader)
         centers = cluster_features(

@@ -236,6 +236,7 @@ class Trainer(BaseTrainer):
         data = to_device(numeric_only(dict(data)))
         data_t = self._frame0(data)
         k_g, k_d, k_loss, k_noise, k_rg, k_rd = jax.random.split(key, 6)
+        # lint: allow(bare-jit) -- one-shot flax init at t=0
         vars_G = dict(jax.jit(
             lambda rngs, d: self.net_G.init(rngs, d, training=True,
                                             init_all=True))(
@@ -257,6 +258,7 @@ class Trainer(BaseTrainer):
         stacks = {f"s{s}": (jnp.zeros((b, tD - 1, h, w, c_img)),
                             jnp.zeros((b, tD - 1, h, w, c_img)))
                   for s in range(self.num_temporal_scales)}
+        # lint: allow(bare-jit) -- one-shot flax init at t=0
         vars_D = dict(jax.jit(
             lambda rngs, d, f, st: self.net_D.init(
                 rngs, d, f, past_stacks=st, training=True))(
@@ -750,6 +752,7 @@ class Trainer(BaseTrainer):
             d_hist.append({k: jnp.sum(v) for k, v in d_tail.items()})
             g_hist.append({k: jnp.sum(v) for k, v in g_tail.items()})
         if self.speed_benchmark:
+            # lint: allow(host-sync) -- speed_benchmark timing fence
             jax.block_until_ready(self.state["vars_G"]["params"])
             self._meter("time/gen_step").write(time.time() - t0)
 
@@ -898,6 +901,7 @@ class Trainer(BaseTrainer):
         path = os.path.join(output_dir, str(key), f"{t:04d}.jpg")
         os.makedirs(os.path.dirname(path), exist_ok=True)
         save_image_grid(
+            # lint: allow(host-sync) -- offline inference image dump
             [tensor2im(np.asarray(jax.device_get(fake))[0])], path)
 
     def _test_sequences(self, dataset, output_dir, inference_args):

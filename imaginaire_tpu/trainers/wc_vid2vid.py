@@ -149,6 +149,7 @@ class Trainer(Vid2VidTrainer):
         if warp_prev:
             return None
         if self.single_image_vars is None:  # allow_random_init path
+            # lint: allow(bare-jit) -- one-shot flax init of the frozen single-image generator (tests-only fallback)
             self.single_image_vars = jax.jit(
                 lambda k, d: self.single_image_model.init(
                     {"params": k, "noise": k}, d, random_style=True,

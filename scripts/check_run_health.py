@@ -11,6 +11,10 @@ Checks (each can fail the gate):
 - fault tolerance (ISSUE 7): corrupt-checkpoint fallbacks beyond
   ``--max-fallbacks`` (default 0), any ``resilience/resume_divergence``
   meta event (always fatal), and any exhausted retry budget;
+- graph audit (ISSUE 12): static-analysis violations from the compile
+  ledger (``xla/graph_violations``, which includes dead donated
+  arguments) beyond ``--max-graph-violations`` (default 0). Runs
+  without audit counters (audit disabled, old logs) pass unchanged;
 - ``--require-health``: the run must actually carry ``health/*``
   counters (guards against a config that silently disabled diagnostics
   — a green gate over a blind run is worse than a red one).
@@ -47,7 +51,8 @@ from imaginaire_tpu.telemetry.report import (  # noqa: E402
 
 def check_health(summary, require_health=False, max_dg_breaches=0,
                  max_recompiles=0, mem_budget_frac=None,
-                 max_fallbacks=0, max_temp_frac=None):
+                 max_fallbacks=0, max_temp_frac=None,
+                 max_graph_violations=0):
     """Return the list of failure strings for an aggregated summary."""
     failures = []
     health = summary.get("health") or {}
@@ -120,6 +125,27 @@ def check_health(summary, require_health=False, max_dg_breaches=0,
                     f"{temp_frac:.1%} of bytes_limit exceeds "
                     f"--max-temp-frac {max_temp_frac:g} "
                     f"({worst_temp} bytes)")
+    # Graph-audit gate (ISSUE 12): the ledger audits every compiled
+    # program's jaxpr/HLO (host callbacks, f64 leaks, fp32-island
+    # casts, baked constants, dead donated args) and the counter
+    # xla/graph_violations carries the latest per-program totals. Only
+    # runs that actually carried audit counters are gated — an old log
+    # or a run with xla_obs.graph_audit=False passes unchanged.
+    graph = summary.get("graph") or {}
+    g_viol = graph.get("violations", 0)
+    if max_graph_violations is not None and graph.get("present") \
+            and g_viol > max_graph_violations:
+        rules = sorted({
+            v.get("rule") for e in graph.get("violation_events", [])
+            for v in (e.get("violations") or [])} - {None})
+        progs = sorted(label for label, p in
+                       (graph.get("programs") or {}).items()
+                       if p.get("violations"))
+        failures.append(
+            f"{g_viol} graph-audit violation(s) "
+            f"(allowed {max_graph_violations})"
+            + (f": rules {rules}" if rules else "")
+            + (f" in programs {progs}" if progs else ""))
     if xla.get("oom_events"):
         failures.append(
             f"{len(xla['oom_events'])} RESOURCE_EXHAUSTED event(s) — "
@@ -199,6 +225,11 @@ def main(argv=None):
                          "allocation exceeds this fraction of "
                          "bytes_limit (reads the mem_budget meta; "
                          "default: no temp gate)")
+    ap.add_argument("--max-graph-violations", type=int, default=0,
+                    help="tolerated static graph-audit violations "
+                         "(xla/graph_violations — includes dead "
+                         "donated args; default 0). Runs without "
+                         "audit counters pass.")
     ap.add_argument("--max-fallbacks", type=int, default=0,
                     help="tolerated corrupt-checkpoint fallbacks "
                          "(resilience/ckpt_fallbacks; default 0 — "
@@ -231,7 +262,8 @@ def main(argv=None):
                             max_recompiles=args.max_recompiles,
                             mem_budget_frac=args.mem_budget_frac,
                             max_fallbacks=args.max_fallbacks,
-                            max_temp_frac=args.max_temp_frac)
+                            max_temp_frac=args.max_temp_frac,
+                            max_graph_violations=args.max_graph_violations)
     health = summary.get("health") or {}
     xla = summary.get("xla") or {}
     res = summary.get("resilience") or {}
@@ -252,6 +284,16 @@ def main(argv=None):
             # informational only — flow_cache/* counters never trip the
             # gate (an amortized-teacher run is not unhealthy)
             "flow_cache": summary.get("flow_cache") or {"present": False},
+            "graph": {
+                "present": (summary.get("graph") or {}).get("present",
+                                                            False),
+                "violations": (summary.get("graph") or {}).get(
+                    "violations", 0),
+                "dead_donations": (summary.get("graph") or {}).get(
+                    "dead_donations", 0),
+                "collective_bytes": (summary.get("graph") or {}).get(
+                    "collective_bytes", 0),
+            },
             "resilience": {
                 "fallbacks": res.get("fallbacks", 0),
                 "quarantined": res.get("quarantined", 0),
@@ -296,7 +338,9 @@ def _main_hosts(args):
                                 max_recompiles=args.max_recompiles,
                                 mem_budget_frac=args.mem_budget_frac,
                                 max_fallbacks=args.max_fallbacks,
-                                max_temp_frac=args.max_temp_frac)
+                                max_temp_frac=args.max_temp_frac,
+                                max_graph_violations=
+                                args.max_graph_violations)
         verdicts[label] = {"path": fpath, "healthy": not failures,
                            "failures": failures}
         any_fail = any_fail or bool(failures)

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Trace-safety lint + static graph audit — the single CI entry for
+ISSUE 12's auditor.
+
+Two legs, both exiting 1 on any violation:
+
+- ``--all`` (source lint, fast, no jax import): run the AST rules in
+  ``imaginaire_tpu/analysis/ast_rules.py`` over every repo .py file —
+  no bare ``jax.jit`` outside the ledger, no host syncs in step-path
+  modules, no untimed barriers, no numpy.random inside traced code, no
+  mutable default pytrees. Violations must be FIXED or allowlisted
+  inline with a reason (``# lint: allow(rule) -- why``); a reasonless
+  allow is itself a violation. Suppressions are printed with their
+  reasons — nothing is silent.
+
+- ``--families all`` (graph audit, ~1 min on CPU): build each of the 9
+  trainer families from its unit-test config, ``jit.trace`` every
+  ledgered step program on ShapeDtypeStruct inputs (no compile, no
+  compute) and audit the closed jaxpr — host callbacks, f64 leaks,
+  bf16 casts inside declared fp32 islands, oversized baked constants.
+  ``--aux`` adds the shared non-trainer programs (flow teacher,
+  inception extractor).
+
+Usage:
+    python scripts/lint_graph.py --all                # source lint
+    python scripts/lint_graph.py --families all       # 9-family audit
+    python scripts/lint_graph.py --families spade vid2vid --aux
+    python scripts/lint_graph.py --all --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def run_source_lint(json_out=False):
+    """AST-lint the repo; returns (exit_code, payload)."""
+    from imaginaire_tpu.analysis import ast_rules
+
+    violations, suppressions = ast_rules.lint_repo(REPO_ROOT)
+    payload = {
+        "violations": [v.as_dict() for v in violations],
+        "suppressions": [{"rule": s.rule, "path": s.path,
+                          "line": s.line, "reason": s.reason}
+                         for s in suppressions],
+    }
+    if not json_out:
+        for v in violations:
+            print(f"lint_graph: FAIL {v.path}:{v.line} [{v.rule}] "
+                  f"{v.message}")
+        if suppressions:
+            print(f"lint_graph: {len(suppressions)} allowlisted "
+                  f"suppression(s):")
+            for s in suppressions:
+                print(f"  allow {s.path}:{s.line} [{s.rule}] — "
+                      f"{s.reason}")
+        if not violations:
+            print("lint_graph: source lint OK "
+                  f"({len(suppressions)} allowlisted)")
+    return (1 if violations else 0), payload
+
+
+def _audit_violations(audits):
+    """Flatten {label: audit_dict} into printable violation rows."""
+    rows = []
+    for label, audit in sorted(audits.items()):
+        for v in audit.get("violations", []):
+            rows.append((label, v))
+        for where, err in (audit.get("errors") or {}).items():
+            rows.append((label, {"rule": "audit-error", "path": where,
+                                 "message": str(err)}))
+    return rows
+
+
+def run_family_audits(families, include_aux, json_out=False):
+    """Trace-audit the requested trainer families (and optionally the
+    aux programs); returns (exit_code, payload)."""
+    from imaginaire_tpu.analysis import audit_program, programs
+
+    payload = {}
+    bad = 0
+    for family in families:
+        audits = programs.audit_family(family)
+        payload[family] = audits
+        rows = _audit_violations(audits)
+        bad += len(rows)
+        if not json_out:
+            for label, v in rows:
+                print(f"lint_graph: FAIL {family}/{label} "
+                      f"[{v.get('rule')}] {v.get('path', '')} "
+                      f"{v.get('message', '')}")
+            total_coll = sum(
+                (a.get("collectives") or {}).get("bytes", 0) or 0
+                for a in audits.values())
+            print(f"lint_graph: {family}: "
+                  f"{len(audits)} program(s), {len(rows)} violation(s), "
+                  f"collective bytes {total_coll}")
+    if include_aux:
+        audits = {}
+        for label, traced in programs.trace_aux_programs():
+            audits[label] = audit_program(label, traced=traced,
+                                          include_hlo=False)
+        payload["aux"] = audits
+        rows = _audit_violations(audits)
+        bad += len(rows)
+        if not json_out:
+            for label, v in rows:
+                print(f"lint_graph: FAIL aux/{label} "
+                      f"[{v.get('rule')}] {v.get('path', '')} "
+                      f"{v.get('message', '')}")
+            print(f"lint_graph: aux: {len(audits)} program(s), "
+                  f"{len(rows)} violation(s)")
+    if not json_out and not bad:
+        print("lint_graph: graph audit OK")
+    return (1 if bad else 0), payload
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Trace-safety lint + static graph audit (ISSUE 12)")
+    ap.add_argument("--all", action="store_true",
+                    help="AST-lint every repo .py file (fast; the "
+                         "dryrun/CI entry)")
+    ap.add_argument("--families", nargs="*", default=None,
+                    metavar="FAMILY",
+                    help="trace-audit these trainer families "
+                         "('all' = every family)")
+    ap.add_argument("--aux", action="store_true",
+                    help="with --families: also audit the shared "
+                         "non-trainer programs (flow teacher, "
+                         "inception extractor)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable JSON instead of lines")
+    args = ap.parse_args(argv)
+    if not args.all and args.families is None:
+        ap.error("nothing to do: pass --all and/or --families")
+
+    rc = 0
+    out = {}
+    if args.all:
+        lint_rc, out["lint"] = run_source_lint(json_out=args.json)
+        rc = max(rc, lint_rc)
+    if args.families is not None:
+        from imaginaire_tpu.analysis import programs
+
+        fams = list(args.families)
+        if not fams or "all" in fams:
+            fams = list(programs.FAMILIES)
+            args.aux = True
+        unknown = [f for f in fams if f not in programs.FAMILIES]
+        if unknown:
+            ap.error(f"unknown families {unknown}; "
+                     f"choose from {list(programs.FAMILIES)}")
+        fam_rc, out["families"] = run_family_audits(
+            fams, args.aux, json_out=args.json)
+        rc = max(rc, fam_rc)
+    if args.json:
+        print(json.dumps(out, indent=1, default=str))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

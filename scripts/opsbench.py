@@ -37,7 +37,10 @@ K_SMALL, K_LARGE = 2, 12
 
 def _looped(fn, k):
     """Run ``fn`` k times serialized by a data dependence, so the chain
-    can't be parallelized or folded away; returns the accumulated sum."""
+    can't be parallelized or folded away; returns the accumulated sum.
+    Ledgered so the bench compiles carry compile-time counters and the
+    graph audit like every other compile site."""
+    from imaginaire_tpu.telemetry import xla_obs
 
     def run(*args):
         def body(_, acc):
@@ -46,7 +49,8 @@ def _looped(fn, k):
 
         return jax.lax.fori_loop(0, k, body, jnp.float32(0.0))
 
-    return jax.jit(run)
+    label = getattr(fn, "__name__", None) or "op"
+    return xla_obs.compiled_program(f"opsbench/{label}x{k}", run)
 
 
 def measure(fn, *args):

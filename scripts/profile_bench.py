@@ -72,12 +72,12 @@ def main():
 
     # --- component programs (jitted once each; executed after the full
     # steps so the optimizer/EMA arrays can be freed first) ---
-    @jax.jit
+    @jax.jit  # lint: allow(bare-jit) -- profiler harness measures the raw jit path on purpose
     def g_apply(vars_G, data, rng):
         out, _ = trainer._apply_G(vars_G, data, rng, training=True)
         return out["fake_images"]
 
-    @jax.jit
+    @jax.jit  # lint: allow(bare-jit) -- profiler harness measures the raw jit path on purpose
     def d_apply(vars_D, data, fake):
         # reduce over EVERY output so XLA can't dead-code-eliminate any
         # branch of the D graph (returning one sliced logit once made
@@ -88,12 +88,12 @@ def main():
             (out["fake_outputs"], out["fake_features"]))
         return sum(jnp.sum(leaf.astype(jnp.float32)) for leaf in leaves)
 
-    @jax.jit
+    @jax.jit  # lint: allow(bare-jit) -- profiler harness measures the raw jit path on purpose
     def vgg_fwd(loss_params, fake, real):
         return trainer.perceptual(loss_params["perceptual"], fake,
                                   real.astype(fake.dtype))
 
-    @jax.jit
+    @jax.jit  # lint: allow(bare-jit) -- profiler harness measures the raw jit path on purpose
     def gen_loss_fwd(state, data):
         losses, _ = trainer.gen_forward(
             trainer._cast_net_vars(state["vars_G"]),
@@ -102,7 +102,7 @@ def main():
         return trainer._total(
             {k: v.astype(jnp.float32) for k, v in losses.items()})
 
-    @jax.jit
+    @jax.jit  # lint: allow(bare-jit) -- profiler harness measures the raw jit path on purpose
     def gen_loss_grad(state, data):
         def loss_fn(params_G):
             vg = dict(state["vars_G"],
@@ -115,7 +115,7 @@ def main():
 
         return jax.grad(loss_fn)(state["vars_G"]["params"])
 
-    @jax.jit
+    @jax.jit  # lint: allow(bare-jit) -- profiler harness measures the raw jit path on purpose
     def dis_loss_fwd(state, data):
         losses, _ = trainer.dis_forward(
             trainer._cast_net_vars(state["vars_G"]),

@@ -78,7 +78,9 @@ class TestSpectralNormIsland:
     def test_power_iteration_refuses_bf16_u(self, rng):
         w = jnp.asarray(rng.randn(4, 6).astype(np.float32))
         u = jnp.ones((4,), jnp.bfloat16)
-        with pytest.raises(AssertionError, match="float32"):
+        from imaginaire_tpu.analysis import islands
+
+        with pytest.raises(islands.IslandViolation, match="float32"):
             power_iteration(w, u)
 
     def test_estimate_sigma_fp32_from_bf16(self, rng):
