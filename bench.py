@@ -130,6 +130,16 @@ def _resilience_leg():
         "process_count": _process_count(),
         "cluster_desyncs": int(
             counters.get("resilience/cluster_desyncs", 0) or 0),
+        # elastic resizes (ISSUE 13): a bench leg that reshaped its pod
+        # mid-run measured TWO topologies — the resize count, the total
+        # downtime, and the redistributed state bytes must ride the
+        # JSON next to the throughput
+        "resizes": int(
+            counters.get("elastic/resizes", 0) or 0),
+        "resize_downtime_ms": float(
+            counters.get("elastic/downtime_ms", 0) or 0),
+        "redistributed_bytes": int(
+            counters.get("elastic/redistributed_bytes", 0) or 0),
     }
 
 

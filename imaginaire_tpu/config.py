@@ -365,6 +365,36 @@ def default_config():
                 heartbeat_interval_s=10.0,
                 heartbeat_timeout_s=60.0,
             ),
+            # elastic pods (resilience/elastic.py, ISSUE 11): on a
+            # peer-loss signal the survivors run a KV consensus, re-init
+            # jax.distributed in-process with the shrunken world, and
+            # resume from the emergency checkpoint — the pod keeps
+            # training at N-1 hosts instead of idling until capacity
+            # returns; a respawned host rejoins through
+            # <logdir>/elastic/ and the pod grows back (gate with
+            # grow_back=False to pin the shrunken world). min_world_size is
+            # the smallest world the survivors may reshape to (below
+            # it: the classic all-exit-75 stop-the-world).
+            # resize_timeout_s bounds the survivor vote;
+            # port_stride spaces each generation's fresh coordination
+            # service along the port line from the base coordinator;
+            # heartbeat/init knobs tune the raw distributed client
+            # (fast peer-loss detection, bounded teardown). Off by
+            # default: elastic re-init is only exercised where the
+            # launcher opted in (launch_local_pod --elastic).
+            elastic=AttrDict(
+                enabled=False,
+                min_world_size=2,
+                resize_timeout_s=60.0,
+                grow_back=True,
+                join_poll_s=0.25,
+                join_timeout_s=600.0,
+                port_stride=17,
+                heartbeat_interval_s=1.0,
+                max_missing_heartbeats=5,
+                init_timeout_s=120.0,
+                shutdown_timeout_s=5.0,
+            ),
         ),
         # -- chaos harness (resilience/chaos.py): deterministic fault
         # injection at configured steps so the recovery paths above stay

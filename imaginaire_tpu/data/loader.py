@@ -6,9 +6,23 @@ index slice ``process_index::process_count`` of the shuffled epoch
 (ref sharding: utils/dataset.py:46-50), batches on the host, and yields
 dicts of stacked NHWC arrays. ``set_epoch`` reseeds the shuffle like
 ``DistributedSampler.set_epoch`` (ref: train.py:70).
+
+Elastic pods (ISSUE 11) add a second split mode: with
+``global_batch_size`` set, the loader fixes the GLOBAL batch and splits
+each global batch block-contiguously — host ``i`` takes rows
+``[i*share, (i+1)*share)`` of every batch, and the per-host batch size
+is derived from the LIVE world size at iteration time. The strided
+split permutes the sample -> mesh-position assignment whenever the
+world size changes (different hosts, different rows — a float reduction
+over a different operand order is not bit-stable); the block split
+keeps global batch ``k`` == ``order[k*G:(k+1)*G]`` in mesh-device order
+for ANY world size, which is what makes a 3->2->3 resize bit-exact
+against the never-resized run.
 """
 
 from __future__ import annotations
+
+import logging
 
 import numpy as np
 
@@ -16,11 +30,13 @@ from imaginaire_tpu.config import cfg_get
 from imaginaire_tpu.parallel.mesh import get_rank, get_world_size
 from imaginaire_tpu.registry import resolve
 
+logger = logging.getLogger(__name__)
+
 
 class DataLoader:
     def __init__(self, dataset, batch_size, shuffle=True, seed=0,
                  drop_last=True, num_workers=0, prefetch_batches=2,
-                 shard_by_process=True):
+                 shard_by_process=True, global_batch_size=None):
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -37,6 +53,35 @@ class DataLoader:
         # 7): the next __iter__ drops the first N index-batches of the
         # (deterministically seeded) epoch order without loading them
         self._skip_batches = 0
+        # elastic (ISSUE 11): a set global_batch_size pins the GLOBAL
+        # batch and switches to the block-contiguous split; the
+        # per-host batch size becomes global // live-world, re-derived
+        # at every access so the SAME loader object keeps yielding
+        # correctly after an in-process mesh resize
+        self.global_batch_size = (int(global_batch_size)
+                                  if global_batch_size else None)
+        self._warned_indivisible = None
+
+    @property
+    def batch_size(self):
+        if self.global_batch_size:
+            world = get_world_size() if self.shard_by_process else 1
+            share, rem = divmod(self.global_batch_size, max(world, 1))
+            if rem and self._warned_indivisible != world:
+                self._warned_indivisible = world
+                logger.warning(
+                    "global_batch_size %d is not divisible by world "
+                    "size %d — flooring the per-host batch to %d "
+                    "(global batch shrinks to %d; cross-world-size "
+                    "bit-exactness is lost at this world)",
+                    self.global_batch_size, world, max(share, 1),
+                    max(share, 1) * world)
+            return max(share, 1)
+        return self._batch_size
+
+    @batch_size.setter
+    def batch_size(self, value):
+        self._batch_size = value
 
     def set_epoch(self, epoch):
         self.epoch = epoch
@@ -66,6 +111,11 @@ class DataLoader:
         return retry_call(_read, label="loader")
 
     def __len__(self):
+        if self.global_batch_size and self.shard_by_process:
+            # block mode: the epoch is measured in GLOBAL batches, a
+            # world-size-invariant count (each host sees len() batches
+            # of its share of every global batch)
+            return max(len(self.dataset) // self.global_batch_size, 1)
         shards = get_world_size() if self.shard_by_process else 1
         n = len(self.dataset) // shards
         if self.drop_last:
@@ -80,6 +130,20 @@ class DataLoader:
         if not self.shard_by_process:
             return order
         world = get_world_size()
+        if self.global_batch_size:
+            # block-contiguous split (ISSUE 11): global batch k is
+            # order[k*G:(k+1)*G] regardless of world size; host i owns
+            # rows [i*share, (i+1)*share) of each. Concatenated across
+            # hosts in process order (== mesh-device order under the
+            # even-spread sub-mesh pick), every global batch is
+            # IDENTICAL at any world size — the property the elastic
+            # bit-exactness drill checks.
+            g = self.global_batch_size
+            share = self.batch_size
+            nb = len(order) // g
+            blocks = order[:nb * g].reshape(nb, g)
+            i = get_rank()
+            return blocks[:, i * share:(i + 1) * share].reshape(-1)
         # every process must see the SAME number of items per epoch
         # (ISSUE 8): the bare strided split hands early ranks one item
         # more when len(dataset) is not divisible — on a pod that means
@@ -201,12 +265,20 @@ def get_train_and_val_dataloader(cfg, seed=0):
     val_ds = _build_dataset(cfg, is_inference=True)
     num_workers = cfg_get(cfg.data, "num_workers", 0)
     prefetch = cfg_get(cfg.data, "prefetch", 2)
+    # elastic pods (ISSUE 11): data.train.global_batch_size pins the
+    # GLOBAL batch and activates the block-contiguous split — the
+    # per-host batch follows the live world size across resizes
+    global_bs = cfg_get(cfg.data.train, "global_batch_size", None)
     train = DataLoader(train_ds, cfg_get(cfg.data.train, "batch_size", 1),
                        shuffle=True, seed=seed, num_workers=num_workers,
-                       prefetch_batches=prefetch)
+                       prefetch_batches=prefetch,
+                       global_batch_size=global_bs)
     val = DataLoader(val_ds, cfg_get(cfg.data.val, "batch_size", 1),
                      shuffle=False, seed=seed, num_workers=num_workers,
-                     prefetch_batches=prefetch)
+                     prefetch_batches=prefetch,
+                     global_batch_size=cfg_get(cfg.data.val,
+                                               "global_batch_size",
+                                               None))
     return train, val
 
 

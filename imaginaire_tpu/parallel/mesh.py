@@ -56,6 +56,18 @@ def init_distributed(coordinator_address=None, num_processes=None, process_id=No
                                   "gloo")
             except Exception:  # noqa: BLE001 — older jaxlib: no knob
                 pass
+        if os.environ.get("IMAGINAIRE_ELASTIC") == "1":
+            # elastic pods (resilience/elastic.py, ISSUE 11): the
+            # runtime must survive peer loss (benign missed-heartbeat
+            # callback) and tolerate in-process teardown/re-init — the
+            # stock initializer's client kills the process on a lost
+            # peer and blocks at exit in a collective shutdown barrier
+            from imaginaire_tpu.resilience import elastic
+
+            elastic.raw_init(coordinator_address, int(num_processes),
+                             int(process_id or 0),
+                             settings=elastic.env_settings())
+            return
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
@@ -104,6 +116,45 @@ def honor_platform_env():
         jax.config.update("jax_platforms", plat)
 
 
+def _resolve_dims(axes, shape, n_devices):
+    """Normalize a mesh shape request into a dims list aligned with
+    ``axes`` (None => all devices on the first axis)."""
+    if shape is None:
+        return [int(n_devices)] + [1] * (len(axes) - 1)
+    if isinstance(shape, (list, tuple)):
+        if len(shape) != len(axes):
+            raise ValueError(f"shape {shape} does not align with axes {axes}")
+        return [int(s) for s in shape]
+    return [int(shape[a]) if (hasattr(shape, "__getitem__") and a in shape) else 1 for a in axes]
+
+
+def _submesh_devices(flat, want):
+    """Pick ``want`` of the available devices for a sub-mesh.
+
+    Single-process: the first ``want`` in id order (the seed behavior,
+    byte-stable for every existing virtual-device test). Multi-process
+    (ISSUE 11): spread the pick EVENLY across processes in
+    ``(process_index, id)`` order — elastic pods over-provision
+    devices per host so the logical mesh can stay constant across
+    resizes, and a first-``want`` pick would park entire hosts outside
+    the mesh (a 6-device mesh on 3 hosts x 3 devices would take all of
+    p0+p1 and none of p2, leaving p2 with no addressable shard). Falls
+    back to the first ``want`` when the spread doesn't divide evenly.
+    """
+    devs = sorted(flat.tolist(),
+                  key=lambda d: (getattr(d, "process_index", 0), d.id))
+    by_proc = {}
+    for d in devs:
+        by_proc.setdefault(getattr(d, "process_index", 0), []).append(d)
+    n_procs = len(by_proc)
+    per = want // n_procs if n_procs else 0
+    if (n_procs > 1 and want % n_procs == 0
+            and all(len(v) >= per for v in by_proc.values())):
+        return np.array([d for p in sorted(by_proc)
+                         for d in by_proc[p][:per]])
+    return np.array(devs[:want])
+
+
 def create_mesh(axes=("data",), shape=None, devices=None):
     """Create a Mesh over the given logical axes.
 
@@ -114,28 +165,105 @@ def create_mesh(axes=("data",), shape=None, devices=None):
     """
     devices = np.asarray(devices if devices is not None else jax.devices())
     axes = tuple(axes)
-    if shape is None:
-        dims = [devices.size] + [1] * (len(axes) - 1)
-    elif isinstance(shape, (list, tuple)):
-        if len(shape) != len(axes):
-            raise ValueError(f"shape {shape} does not align with axes {axes}")
-        dims = [int(s) for s in shape]
-    else:
-        dims = [int(shape[a]) if (hasattr(shape, "__getitem__") and a in shape) else 1 for a in axes]
+    dims = _resolve_dims(axes, shape, devices.size)
     want = int(np.prod(dims))
     if want > devices.size:
         raise ValueError(f"mesh shape {dims} != device count {devices.size}")
     if want < devices.size:
         # an explicit sub-mesh request (e.g. a (2,2) plan on an 8-chip
-        # host): take the first prod(shape) devices instead of failing —
-        # the remaining devices simply stay out of this mesh
+        # host): take prod(shape) devices instead of failing — evenly
+        # spread across processes on a pod (see _submesh_devices), the
+        # remaining devices simply stay out of this mesh
         import logging
 
         logging.getLogger(__name__).info(
             "mesh shape %s uses %d of %d devices", dims, want,
             devices.size)
-        devices = devices.reshape(-1)[:want]
+        devices = _submesh_devices(devices.reshape(-1), want)
     return Mesh(devices.reshape(dims), axes)
+
+
+def fit_mesh_shape(cfg, total_devices):
+    """(axes, dims) the configured mesh takes on ``total_devices``
+    devices — the elastic re-derivation (ISSUE 11).
+
+    When the configured shape still fits (elastic pods over-provision
+    devices per host precisely so it does), it is returned unchanged
+    and the logical mesh — hence the training math — survives the
+    resize bit-exactly. When the surviving devices can no longer cover
+    it, the shape shrinks by the divisibility rules: data parallelism
+    is preserved first (the ZeRO update-state sharding lives there),
+    the model/other axes keep the largest divisor that still maximizes
+    used devices, ties collapse toward pure DP. A model axis collapsed
+    to 1 warns loudly (its partition rules go dead — params replicate);
+    devices left idle at odd world sizes warn too.
+    """
+    import logging
+    import math
+
+    from imaginaire_tpu.config import cfg_get
+
+    log = logging.getLogger(__name__)
+    pcfg = cfg_get(cfg or {}, "parallel", None) or {}
+    shape = cfg_get(pcfg, "mesh_shape", None)
+    if shape is not None:
+        axes = tuple(cfg_get(pcfg, "axes", None) or (DATA_AXIS, MODEL_AXIS))
+    else:
+        rcfg = cfg_get(cfg_get(cfg or {}, "runtime", None) or {}, "mesh",
+                       None) or {}
+        axes = tuple(cfg_get(rcfg, "axes", None) or (DATA_AXIS,))
+        shape = cfg_get(rcfg, "shape", None)
+    if shape is None:
+        return axes, None  # all devices on the first axis, any count
+    total = int(total_devices)
+    dims = _resolve_dims(axes, shape, total)
+    if int(np.prod(dims)) <= total:
+        return axes, dims
+    data_idx = axes.index(DATA_AXIS) if DATA_AXIS in axes else 0
+    other_total = int(np.prod([d for k, d in enumerate(dims)
+                               if k != data_idx]))
+    # pick the non-data extent m (a divisor of the requested extent)
+    # maximizing used devices m * (total // m); ties collapse toward
+    # pure DP — the update-state sharding rides the data axis
+    best_m, best_used = 1, 0
+    for m in range(1, other_total + 1):
+        if other_total % m or m > total:
+            continue
+        used = m * (total // m)
+        if used > best_used:
+            best_m, best_used = m, used
+    new_dims = list(dims)
+    new_dims[data_idx] = max(total // best_m, 1)
+    remaining = best_m
+    for k in range(len(dims)):
+        if k == data_idx:
+            continue
+        d = math.gcd(remaining, int(dims[k]))
+        new_dims[k] = d
+        remaining //= d
+    if remaining != 1:
+        # the divisor doesn't factor over the axes' caps — collapse the
+        # leftovers into the data axis rather than over-claim devices
+        new_dims = [1 if k != data_idx else max(total // 1, 1)
+                    for k in range(len(dims))]
+        new_dims[data_idx] = total
+    model_idx = axes.index(MODEL_AXIS) if MODEL_AXIS in axes else None
+    if (model_idx is not None and int(dims[model_idx]) > 1
+            and int(new_dims[model_idx]) == 1):
+        log.warning(
+            "elastic resize: model axis collapsed %d -> 1 at %d "
+            "device(s) — the partition rules that sharded over 'model' "
+            "go dead (params replicate) until the pod grows back",
+            int(dims[model_idx]), total)
+    used = int(np.prod(new_dims))
+    if used < total:
+        log.warning(
+            "elastic resize: mesh %s uses %d of %d device(s) — %d "
+            "idle at this world size (indivisible shape)",
+            new_dims, used, total, total - used)
+    log.info("elastic resize: mesh shape %s -> %s on %d device(s)",
+             dims, new_dims, total)
+    return axes, new_dims
 
 
 def mesh_from_config(cfg, devices=None):
@@ -196,14 +324,37 @@ def peek_mesh():
     return _GLOBAL_MESH
 
 
+# Last values jax reported before an elastic teardown window (ISSUE
+# 13): between force_teardown and the re-init, jax.process_index()
+# does not just fail — it tries to REBUILD the cpu backend, whose gloo
+# collectives factory needs the now-detached distributed client. Any
+# master-gated print/log in that window would crash the process.
+_LAST_RANK = None
+_LAST_WORLD = None
+
+
 def get_rank():
     """Host-process index (ref: utils/distributed.py:20-26)."""
-    return jax.process_index()
+    global _LAST_RANK
+    try:
+        _LAST_RANK = jax.process_index()
+        return _LAST_RANK
+    except RuntimeError:
+        if _LAST_RANK is not None:
+            return _LAST_RANK
+        raise
 
 
 def get_world_size():
     """Number of host processes (ref: utils/distributed.py:29-35)."""
-    return jax.process_count()
+    global _LAST_WORLD
+    try:
+        _LAST_WORLD = jax.process_count()
+        return _LAST_WORLD
+    except RuntimeError:
+        if _LAST_WORLD is not None:
+            return _LAST_WORLD
+        raise
 
 
 def is_master():

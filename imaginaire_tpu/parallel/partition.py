@@ -291,10 +291,20 @@ class PartitionPlan:
 
     def place_state(self, state):
         """Commit ``state`` to device under the plan's shardings; also
-        returns the sharding tree the step programs constrain against."""
+        returns the sharding tree the step programs constrain against.
+
+        Multi-process placement assembles each leaf from the locally
+        held full value (``assemble_global``) instead of
+        ``jax.device_put`` — the latter broadcast-verifies every host
+        leaf cross-process and aborts the CPU collective transport when
+        a process owns more than one device (ISSUE 11)."""
         import jax
 
         shardings = self.state_shardings(state)
+        if jax.process_count() > 1:
+            from imaginaire_tpu.parallel.sharding import assemble_global
+
+            return assemble_global(state, shardings), shardings
         return jax.device_put(state, shardings), shardings
 
     def constrain_state(self, state, shardings):

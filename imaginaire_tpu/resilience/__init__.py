@@ -24,8 +24,13 @@ the training entry point owns signal handlers.
 """
 
 from imaginaire_tpu.config import cfg_get
-from imaginaire_tpu.resilience import chaos, cluster
+from imaginaire_tpu.resilience import chaos, cluster, elastic
 from imaginaire_tpu.resilience.cluster import ClusterDesyncError
+from imaginaire_tpu.resilience.elastic import (
+    ElasticCoordinator,
+    ElasticResize,
+    elastic_settings,
+)
 from imaginaire_tpu.resilience.integrity import (
     CheckpointIntegrityError,
     quarantine_checkpoint,
@@ -33,6 +38,7 @@ from imaginaire_tpu.resilience.integrity import (
     verify_tree,
 )
 from imaginaire_tpu.resilience.preemption import (
+    EXIT_ELASTIC_RESTART,
     EXIT_PREEMPTED,
     PreemptionGuard,
     install_preemption_guard,
@@ -51,12 +57,17 @@ from imaginaire_tpu.resilience.runstate import (
 __all__ = [
     "CheckpointIntegrityError",
     "ClusterDesyncError",
+    "EXIT_ELASTIC_RESTART",
     "EXIT_PREEMPTED",
+    "ElasticCoordinator",
+    "ElasticResize",
     "PreemptionGuard",
     "build_runstate",
     "chaos",
     "cluster",
     "configure",
+    "elastic",
+    "elastic_settings",
     "install_preemption_guard",
     "quarantine_checkpoint",
     "read_runstate",

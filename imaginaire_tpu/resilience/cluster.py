@@ -17,7 +17,11 @@ loud, named, bounded failure:
   time + last step) every ``heartbeat_interval_s``. ``peer_status``
   reads all stamps; the PR-2 hang watchdog folds it into its dump so a
   distributed stall names the stalled process index, not just "no step
-  completed here".
+  completed here". Stamps are scoped to the pod's *membership epoch*
+  (the elastic generation, ISSUE 13): after a resize the survivors
+  stamp ``hb/e<E>/p<i>`` and ``peer_status`` only reads the current
+  epoch's scope, so a departed host's final stamps never report it as
+  a stalled peer of a membership it is no longer part of.
 - ``coordinate_preemption(step, local_flag)`` — the per-step vote that
   makes the PR-7 SIGTERM drain *collective*: a signal lands on ONE
   host, but the emergency checkpoint is a collective orbax save, so
@@ -176,6 +180,34 @@ def _next_epoch(name):
     return k
 
 
+def export_barrier_epochs():
+    """Snapshot of the per-name barrier counters (ISSUE 11): rides the
+    elastic ``ResizePlan``/``topology.json`` so every post-resize
+    member resumes the SAME counter values."""
+    with _BARRIER_LOCK:
+        return dict(_BARRIER_EPOCH)
+
+
+def adopt_barrier_epochs(epochs):
+    """Fast-forward the local barrier counters to a cluster snapshot
+    (ISSUE 11). A process that (re)joins an elastic pod starts its
+    counters at zero while the survivors carry theirs forward — its
+    next counter-tagged rendezvous would wait at ``name:0`` against
+    peers at ``name:k`` and trip a spurious ``ClusterDesyncError``.
+    Max-merge (never rewind: a reused barrier id is poison to the
+    coordination service) keeps everyone aligned."""
+    if not epochs:
+        return
+    with _BARRIER_LOCK:
+        for name, value in dict(epochs).items():
+            try:
+                value = int(value)
+            except (TypeError, ValueError):
+                continue
+            if value > _BARRIER_EPOCH.get(name, 0):
+                _BARRIER_EPOCH[name] = value
+
+
 def timed_barrier(name, timeout_s=None, tag=None):
     """Cluster rendezvous that raises instead of hanging.
 
@@ -256,7 +288,8 @@ def _desync_event(bid, absent, arrived, timeout_s, error):
 
 # ------------------------------------------------- preemption voting
 
-def coordinate_preemption(step, local_flag, timeout_s=None):
+def coordinate_preemption(step, local_flag, timeout_s=None,
+                          return_flagged=False):
     """Collective OR of per-host preemption flags at iteration ``step``.
 
     The SIGTERM drain (PR 7) must be entered by EVERY host at the same
@@ -266,6 +299,11 @@ def coordinate_preemption(step, local_flag, timeout_s=None):
     vote set — the barrier guarantees every vote is visible to every
     reader, so all hosts compute the same OR for the same step.
 
+    ``return_flagged=True`` returns ``(or, flagged_indices)`` instead
+    of the bare OR — the elastic drain split (ISSUE 11) needs to know
+    WHICH host(s) are leaving to decide whether the survivors can
+    reshape in-process rather than the whole pod exiting.
+
     Single-process: returns ``local_flag`` unchanged, no RPC.
     Raises ``ClusterDesyncError`` when a peer never votes (stalled) —
     the per-step vote doubles as the pod's liveness probe.
@@ -273,6 +311,9 @@ def coordinate_preemption(step, local_flag, timeout_s=None):
     c = client()
     n = process_count()
     if n <= 1 or c is None:
+        if return_flagged:
+            return bool(local_flag), ([process_index()] if local_flag
+                                      else [])
         return bool(local_flag)
     i = process_index()
     step = int(step)
@@ -310,7 +351,12 @@ def coordinate_preemption(step, local_flag, timeout_s=None):
         logger.warning("cluster: process(es) %s flagged preemption at "
                        "step %d — joining the coordinated drain",
                        flagged, step)
-    return bool(local_flag) or bool(flagged)
+    result = bool(local_flag) or bool(flagged)
+    if return_flagged:
+        if local_flag and i not in flagged:
+            flagged = sorted(flagged + [i])
+        return result, flagged
+    return result
 
 
 # ---------------------------------------------------- resume consensus
@@ -359,7 +405,105 @@ def agree_min(name, value, extra=None, timeout_s=None):
     return consensus, votes
 
 
+# ------------------------------------------------- survivor consensus
+
+def agree_survivors(name, generation, payload, survivors, timeout_s=None,
+                    poll_s=0.05):
+    """KV-poll rendezvous among an explicit survivor set (ISSUE 11).
+
+    The service barrier (``timed_barrier``) counts EVERY registered
+    process — after a peer dies it can only time out. The elastic
+    shrink consensus instead publishes each survivor's vote under
+    ``elastic/<name>/<generation>/p<i>`` and POLLS the directory until
+    every survivor's vote is visible: dead processes are simply not
+    waited on. Returns ``{process_index: payload}`` for the survivor
+    set; raises ``ClusterDesyncError`` naming the survivors that never
+    voted within ``timeout_s`` (a second loss during the consensus).
+
+    Single-process (or no client): ``{process_index(): payload}``.
+    """
+    c = client()
+    i = process_index()
+    survivors = sorted(int(p) for p in survivors)
+    if c is None or len(survivors) <= 1:
+        return {i: payload}
+    timeout_s = default_timeout_s() if timeout_s is None else float(
+        timeout_s)
+    prefix = f"elastic/{name}/{int(generation)}/"
+    try:
+        c.key_value_set(prefix + f"p{i}", json.dumps(payload),
+                        allow_overwrite=True)
+    except Exception as e:  # noqa: BLE001
+        logger.warning("cluster: agree_survivors(%s) publish failed: %s",
+                       name, e)
+    deadline = time.time() + max(timeout_s, 0.001)
+    votes = {}
+    while True:
+        try:
+            entries = c.key_value_dir_get(prefix)
+        except Exception:  # noqa: BLE001 — nobody published yet
+            entries = []
+        for key, value in entries:
+            base = key.rsplit("/", 1)[-1]
+            if base.startswith("p"):
+                try:
+                    votes[int(base[1:])] = json.loads(value)
+                except (ValueError, TypeError):
+                    continue
+        if all(p in votes for p in survivors):
+            return {p: votes[p] for p in survivors}
+        if time.time() >= deadline:
+            absent = sorted(set(survivors) - set(votes))
+            _desync_event(f"{name}:{generation}", absent,
+                          sorted(votes), timeout_s,
+                          "survivor consensus timed out")
+            raise ClusterDesyncError(
+                f"elastic consensus {name!r} (generation {generation}) "
+                f"timed out after {timeout_s:g}s: survivor(s) {absent} "
+                f"never voted (voted: {sorted(votes)}; this is process "
+                f"{i}). A second host was lost mid-consensus — exit "
+                f"and let the supervisor restart the pod.",
+                absent=absent, barrier=name)
+        time.sleep(poll_s)
+
+
 # --------------------------------------------------------- heartbeats
+
+_MEMBERSHIP_EPOCH = None  # test override (set_membership_epoch)
+
+
+def membership_epoch():
+    """The pod's current membership epoch — the elastic generation
+    (ISSUE 13). Heartbeat stamps are scoped to it: a host that departed
+    in an earlier membership left its stamps under the OLD epoch's
+    scope, so it never shows up as a ``stalled_peers`` entry of the
+    membership it is no longer part of. Epoch 0 (a never-resized pod)
+    keeps the legacy unscoped ``hb/p<i>`` keys."""
+    if _MEMBERSHIP_EPOCH is not None:
+        return int(_MEMBERSHIP_EPOCH)
+    import os
+
+    try:
+        return int(os.environ.get("IMAGINAIRE_ELASTIC_GENERATION", "0"))
+    except ValueError:
+        return 0
+
+
+def set_membership_epoch(epoch):
+    """Test seam: pin the membership epoch (None restores the
+    environment-derived value)."""
+    global _MEMBERSHIP_EPOCH
+    _MEMBERSHIP_EPOCH = epoch
+
+
+def heartbeat_key(process_idx, epoch=None):
+    """The KV key this process's heartbeat stamps under — epoch-scoped
+    for resized pods, the legacy flat key for epoch 0."""
+    e = membership_epoch() if epoch is None else int(epoch)
+    if e == 0:
+        return f"hb/p{process_idx}"
+    return f"hb/e{e}/p{process_idx}"
+
 
 class ClusterHeartbeat(threading.Thread):
     """Daemon thread stamping this process's liveness into the KV store
@@ -381,7 +525,11 @@ class ClusterHeartbeat(threading.Thread):
             stamp = json.dumps({"t": round(time.time(), 3),
                                 "step": telemetry.get().last_step})
             try:
-                c.key_value_set(f"hb/p{i}", stamp, allow_overwrite=True)
+                # key re-derived per stamp: the epoch is cheap to read
+                # and a long-lived thread must follow a membership
+                # change even if the restart raced it
+                c.key_value_set(heartbeat_key(i), stamp,
+                                allow_overwrite=True)
             except Exception as e:  # noqa: BLE001 — liveness best-effort
                 logger.debug("cluster heartbeat write failed: %s", e)
 
@@ -405,6 +553,16 @@ def start_heartbeat(cfg=None):
     return _HEARTBEAT
 
 
+def stop_heartbeat():
+    """Stop the heartbeat thread (elastic teardown, ISSUE 11): the
+    running thread captured the OLD world's KV client; a fresh
+    ``start_heartbeat`` after re-init binds the new one."""
+    global _HEARTBEAT
+    if _HEARTBEAT is not None:
+        _HEARTBEAT.stop()
+        _HEARTBEAT = None
+
+
 def peer_status(stale_after_s=None):
     """{process_index: {"t", "step", "age_s", "stalled"}} from the
     heartbeat record, or None when not a multi-process run. Processes
@@ -418,12 +576,24 @@ def peer_status(stale_after_s=None):
                      if stale_after_s is None else float(stale_after_s))
     now = time.time()
     out = {}
+    epoch = membership_epoch()
     try:
         entries = c.key_value_dir_get("hb/")
     except Exception:  # noqa: BLE001
         entries = []
     for key, value in entries:
-        base = key.rsplit("/", 1)[-1]
+        # membership-epoch scoping (ISSUE 13): only THIS epoch's stamps
+        # count. Epoch 0 reads the legacy flat ``hb/p<i>`` keys (and
+        # skips any ``hb/e*/`` scope); epoch E reads ``hb/e<E>/p<i>``.
+        parts = [p for p in key.split("/") if p]
+        if "hb" in parts:
+            parts = parts[parts.index("hb") + 1:]
+        if epoch == 0:
+            if len(parts) != 1:
+                continue
+        elif len(parts) != 2 or parts[0] != f"e{epoch}":
+            continue
+        base = parts[-1]
         if not base.startswith("p"):
             continue
         try:

@@ -39,9 +39,13 @@ SIDECAR_SUFFIXES = (".partition.json", ".integrity.json",
 
 def sidecar_files(path):
     """Existing sidecar paths for a checkpoint: the fixed suffixes plus
-    the per-host ``.runstate.p<i>.json`` family (ISSUE 8) — quarantine
-    and GC must move/delete the whole set, discovered by glob so a pod
-    of any size is covered."""
+    the per-host ``.runstate.p<i>.json`` family (ISSUE 8) and its
+    epoch-keyed ``.runstate.e<E>.p<i>.json`` variant from resized pods
+    (ISSUE 13) — quarantine and GC must move/delete the whole set,
+    discovered by glob so a pod of any size is covered. After an
+    elastic shrink the family can name MORE processes than the pod now
+    has; those orphans still die with the checkpoint in GC, but
+    quarantine leaves them in place (see ``orphan_sidecars``)."""
     import glob as _glob
 
     path = str(path)
@@ -49,6 +53,56 @@ def sidecar_files(path):
            if os.path.exists(path + s)]
     out.extend(sorted(_glob.glob(_glob.escape(path)
                                  + ".runstate.p*.json")))
+    out.extend(sorted(_glob.glob(_glob.escape(path)
+                                 + ".runstate.e*.p*.json")))
+    return out
+
+
+def runstate_index(sidecar_path):
+    """Process index of a per-host ``.runstate.p<i>.json`` (or
+    epoch-keyed ``.runstate.e<E>.p<i>.json``, ISSUE 13) sidecar path,
+    or None for every other sidecar kind."""
+    import re
+
+    m = re.search(r"\.runstate\.(?:e\d+\.)?p(\d+)\.json$",
+                  str(sidecar_path))
+    return int(m.group(1)) if m else None
+
+
+def runstate_epoch(sidecar_path):
+    """Membership epoch of an epoch-keyed runstate sidecar; 0 for the
+    legacy unkeyed family, None for non-runstate sidecars."""
+    import re
+
+    s = str(sidecar_path)
+    m = re.search(r"\.runstate\.e(\d+)\.p\d+\.json$", s)
+    if m:
+        return int(m.group(1))
+    if re.search(r"\.runstate(?:\.p\d+)?\.json$", s):
+        return 0
+    return None
+
+
+def orphan_sidecars(path, world_size=None):
+    """Per-host runstate sidecars whose process index no longer exists
+    (``i >= world_size``): an elastic shrink (ISSUE 11) leaves the dead
+    hosts' sidecars behind on checkpoints written by the larger world.
+    They are harmless — resume never reads them (each live process
+    reads its own index, falling back to p0) — so readers warn and
+    ignore; only checkpoint GC retires them, together with the
+    checkpoint they ride."""
+    if world_size is None:
+        try:
+            from imaginaire_tpu.parallel.mesh import get_world_size
+
+            world_size = get_world_size()
+        except Exception:  # noqa: BLE001 — no backend: nothing orphan
+            return []
+    out = []
+    for sidecar in sidecar_files(path):
+        idx = runstate_index(sidecar)
+        if idx is not None and idx >= int(world_size):
+            out.append(sidecar)
     return out
 
 
@@ -273,7 +327,19 @@ def quarantine_checkpoint(path, reason="corrupt"):
         logger.error("failed to quarantine corrupt checkpoint %s: %s",
                      path, e)
         return None
+    orphans = set(orphan_sidecars(path))
     for sidecar in sidecar_files(path):
+        if sidecar in orphans:
+            # elastic shrink leftovers (ISSUE 11): a sidecar for a
+            # process index the pod no longer has must NOT follow the
+            # rename — the numbered-collision suffix of a later
+            # quarantine at the same path would disagree with where its
+            # checkpoint went. Resume ignores it; GC retires it.
+            logger.warning(
+                "quarantine: leaving orphan runstate sidecar %s in "
+                "place (process index >= current world size — an "
+                "elastic shrink left it behind)", sidecar)
+            continue
         try:
             os.replace(sidecar, path + suffix + sidecar[len(path):])
         except OSError:  # the data dir moved; sidecars best-effort

@@ -32,6 +32,12 @@ logger = logging.getLogger(__name__)
 # EX_TEMPFAIL: the conventional "retry me" exit status — distinguishes a
 # preempted-but-checkpointed run from a real failure
 EXIT_PREEMPTED = 75
+# An elastic resize (ISSUE 13) that could not complete in-process
+# (re-init failure, shrink below min_world_size, coordinator loss): the
+# checkpointed state is intact and the supervisor should relaunch the
+# pod at whatever world size it can muster — distinct from 75 so the
+# launcher can tell "host preempted, done" from "pod wants a restart".
+EXIT_ELASTIC_RESTART = 76
 
 
 class PreemptionGuard:
@@ -135,6 +141,15 @@ class PreemptionGuard:
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
+
+    def reset(self):
+        """Clear the drain flag after a drain that did NOT exit (the
+        elastic shrink, ISSUE 11): the survivors committed the flagged
+        host's collective emergency checkpoint and keep training — a
+        sticky flag would re-enter the drain at every later vote."""
+        self.disarm()
+        self._triggered.clear()
+        self.signum = None
 
     # ----------------------------------------------------------- deadline
 

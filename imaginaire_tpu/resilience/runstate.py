@@ -28,13 +28,27 @@ RUNSTATE_VERSION = 1
 _SUFFIX = ".runstate.json"
 
 
-def runstate_path(checkpoint_path, process_index=0):
+def runstate_path(checkpoint_path, process_index=0, epoch=None):
     """Process 0's sidecar keeps the legacy ``.runstate.json`` name
     (single-host checkpoints stay byte-compatible); other hosts get
     ``.runstate.p<i>.json`` (ISSUE 8: the monitor/telemetry halves of
     the run state are per-host — restoring process 3 with process 0's
     EWMA history would be wrong, and before this every non-master
-    host silently lost its half)."""
+    host silently lost its half).
+
+    After an elastic resize (ISSUE 13) process indices are REMAPPED —
+    the process now called p1 may be the host that was p2 when the
+    previous sidecar was written. Sidecars from a resized pod
+    (membership epoch > 0) are therefore keyed by epoch AND rank:
+    ``.runstate.e<E>.p<i>.json`` — an (epoch, rank) pair is stable
+    where a bare rank is not."""
+    if epoch is None:
+        from imaginaire_tpu.resilience.cluster import membership_epoch
+
+        epoch = membership_epoch()
+    if epoch:
+        return (f"{checkpoint_path}.runstate.e{int(epoch)}"
+                f".p{int(process_index)}.json")
     if process_index:
         return f"{checkpoint_path}.runstate.p{int(process_index)}.json"
     return str(checkpoint_path) + _SUFFIX
@@ -55,19 +69,33 @@ def build_runstate(epoch, iteration, batch_in_epoch, monitor=None,
 def write_runstate(checkpoint_path, runstate):
     """Per-host sidecar write (ISSUE 8: every process persists its OWN
     host-side state — process 0 under the legacy name, process i under
-    ``.runstate.p<i>.json``); failures degrade to a warning (a missing
-    runstate means a coarse resume, never a failed save)."""
-    from imaginaire_tpu.parallel.mesh import get_rank
+    ``.runstate.p<i>.json``, epoch-keyed after a resize); failures
+    degrade to a warning (a missing runstate means a coarse resume,
+    never a failed save).
 
-    path = runstate_path(checkpoint_path, get_rank())
+    In a resized pod (epoch > 0) the master ALSO writes the legacy
+    ``.runstate.json``: its epoch/iteration/batch position is
+    cluster-wide truth, and keeping the legacy name current means any
+    future membership — whatever epoch it runs at — can fall back to
+    it when its own (epoch, rank) sidecar does not exist."""
+    from imaginaire_tpu.parallel.mesh import get_rank
+    from imaginaire_tpu.resilience.cluster import membership_epoch
+
+    rank = get_rank()
+    epoch = membership_epoch()
+    path = runstate_path(checkpoint_path, rank, epoch=epoch)
+    targets = [path]
+    if epoch and rank == 0:
+        targets.append(runstate_path(checkpoint_path, 0, epoch=0))
     try:
         from imaginaire_tpu.resilience.retry import retry_call
 
         def _write():
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(runstate, f, indent=1, default=str)
-            os.replace(tmp, path)
+            for target in targets:
+                tmp = target + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(runstate, f, indent=1, default=str)
+                os.replace(tmp, target)
 
         retry_call(_write, label="runstate_write")
         return path
@@ -88,15 +116,65 @@ def read_runstate(checkpoint_path, process_index=None):
         from imaginaire_tpu.parallel.mesh import get_rank
 
         process_index = get_rank()
-    for idx in dict.fromkeys((int(process_index), 0)):
-        path = runstate_path(checkpoint_path, idx)
+    try:
+        # elastic shrink leftovers (ISSUE 11): sidecars for process
+        # indices the pod no longer has are expected after a resize —
+        # name them once and ignore them (never crash, never restore
+        # another world's host-side state)
+        from imaginaire_tpu.resilience.integrity import orphan_sidecars
+
+        orphans = orphan_sidecars(checkpoint_path)
+        if orphans:
+            logger.warning(
+                "ignoring %d orphan runstate sidecar(s) from a larger "
+                "world (elastic shrink): %s", len(orphans),
+                ", ".join(os.path.basename(p) for p in orphans))
+    except Exception:  # noqa: BLE001 — advisory only
+        pass
+    from imaginaire_tpu.resilience.cluster import membership_epoch
+
+    epoch = membership_epoch()
+    # read order (ISSUE 13): this membership's own (epoch, rank)
+    # sidecar first; then — a checkpoint written by a DIFFERENT
+    # membership (pre-resize, or a world this rank wasn't part of) —
+    # the legacy master sidecar, whose epoch/iteration/batch position
+    # is cluster-wide truth. The remap fallback is observable:
+    # ``resilience/runstate_remap`` names what was wanted and what was
+    # used, so a resumed-after-resize run carries the evidence.
+    own = runstate_path(checkpoint_path, int(process_index), epoch=epoch)
+    candidates = [own, runstate_path(checkpoint_path, 0, epoch=0)]
+    for path in dict.fromkeys(candidates):
         if not os.path.exists(path):
             continue
         try:
             with open(path) as f:
-                return json.load(f)
+                payload = json.load(f)
         except (OSError, ValueError) as e:
             logger.warning("unreadable runstate sidecar %s: %s (resuming "
                            "with a coarse epoch restart)", path, e)
             return None
+        if path != own:
+            _emit_runstate_remap(own, path, epoch, int(process_index))
+        return payload
     return None
+
+
+def _emit_runstate_remap(wanted, used, epoch, process_index):
+    """Meta event for a cross-membership runstate fallback: this rank's
+    own (epoch, rank) sidecar was absent and the master's cluster-wide
+    record stood in — expected right after a resize, worth flagging if
+    it persists."""
+    logger.info("runstate remap: %s absent, using %s (membership epoch "
+                "%d, process %d)", os.path.basename(wanted),
+                os.path.basename(used), epoch, process_index)
+    try:
+        from imaginaire_tpu import telemetry
+
+        telemetry.get().meta(
+            "resilience/runstate_remap",
+            wanted=os.path.basename(wanted),
+            used=os.path.basename(used),
+            membership_epoch=int(epoch),
+            process_index=int(process_index))
+    except Exception:  # noqa: BLE001 — advisory only
+        pass

@@ -57,6 +57,8 @@ def summarize(events):
     retry_exhausted = []
     desync_events = []
     consensus_events = []
+    resize_events = []
+    remap_events = []
     graph_events = []
     meta = {}
     hangs = []
@@ -113,6 +115,10 @@ def summarize(events):
                 desync_events.append(ev)
             elif name == "resilience/consensus_resume":
                 consensus_events.append(ev)
+            elif name == "elastic/resize":
+                resize_events.append(ev)
+            elif name == "resilience/runstate_remap":
+                remap_events.append(ev)
             elif name == "graph_violation":
                 graph_events.append(ev)
             elif str(name).startswith("chaos/"):
@@ -196,8 +202,9 @@ def summarize(events):
     resilience = {
         "present": bool(fallback_events or quarantine_events
                         or resume_events or preempt_events
-                        or chaos_events or retries
-                        or any(str(n).startswith("resilience/")
+                        or chaos_events or retries or resize_events
+                        or any(str(n).startswith(("resilience/",
+                                                  "elastic/"))
                                for n in counters)),
         "fallbacks": int(counters.get("resilience/ckpt_fallbacks",
                                       (0, None))[0] or 0)
@@ -226,6 +233,19 @@ def summarize(events):
             or 0) or len(desync_events),
         "desync_events": desync_events,
         "consensus_events": consensus_events,
+        # elastic pods (ISSUE 13): in-process mesh resizes — counted
+        # (check_run_health --max-resizes gates on this) and
+        # carried in full so the report can render old -> new shape
+        # plus the downtime + redistribution breakdown per event
+        "elastic_resizes": int(
+            counters.get("elastic/resizes", (0, None))[0]
+            or 0) or len(resize_events),
+        "resize_downtime_ms": counters.get(
+            "elastic/downtime_ms", (None, None))[0],
+        "redistributed_bytes": counters.get(
+            "elastic/redistributed_bytes", (None, None))[0],
+        "resize_events": resize_events,
+        "runstate_remap_events": remap_events,
     }
     # graph audit (ISSUE 12): per-program static-analysis verdicts from
     # the compile ledger (xla/graph/<label>/* counters hold the LATEST
@@ -442,6 +462,51 @@ def _resilience_section(s):
     return lines
 
 
+def _elasticity_section(s):
+    """Markdown lines for the elastic-pod section (ISSUE 13): resize
+    count, cumulative downtime, redistributed state bytes, and the per
+    -event old -> new topology with the phase + redistribution
+    breakdown. Empty when the run never resized."""
+    r = s.get("resilience") or {}
+    if not (r.get("resize_events") or r.get("elastic_resizes")):
+        return []
+    lines = ["", "## elasticity"]
+    lines.append(f"- resizes: {r.get('elastic_resizes', 0)}")
+    if r.get("resize_downtime_ms") is not None:
+        lines.append(f"- cumulative downtime: "
+                     f"{float(r['resize_downtime_ms']):.0f}ms")
+    if r.get("redistributed_bytes") is not None:
+        lines.append(f"- redistributed state bytes: "
+                     f"{_fmt_bytes(r['redistributed_bytes'])}")
+    for ev in r.get("resize_events", []):
+        phases = ev.get("phases") or {}
+        breakdown = ", ".join(f"{k} {float(v):.0f}ms"
+                              for k, v in phases.items()
+                              if isinstance(v, (int, float)))
+        lines.append(
+            f"- resize (gen {ev.get('generation')}, "
+            f"{ev.get('reason')}): world {ev.get('old_world')} -> "
+            f"{ev.get('new_world')}, mesh {ev.get('old_shape')} -> "
+            f"{ev.get('new_shape')} at iter {ev.get('iteration')}, "
+            f"downtime {float(ev.get('downtime_ms') or 0):.0f}ms"
+            + (f" ({breakdown})" if breakdown else ""))
+        redist = ev.get("redistribution") or {}
+        if redist.get("redistributed_bytes"):
+            lines.append(
+                f"  - moved {_fmt_bytes(redist['redistributed_bytes'])}"
+                f": {redist.get('gather_leaves', 0)} leaf/leaves "
+                f"({_fmt_bytes(redist.get('gather_bytes', 0))}) via "
+                f"live gather, {redist.get('checkpoint_leaves', 0)} "
+                f"({_fmt_bytes(redist.get('checkpoint_bytes', 0))}) "
+                f"via checkpoint reshard")
+    for ev in r.get("runstate_remap_events", []):
+        lines.append(
+            f"- runstate remap: wanted {ev.get('wanted')}, used "
+            f"{ev.get('used')} (epoch {ev.get('membership_epoch')}, "
+            f"p{ev.get('process_index')})")
+    return lines
+
+
 def render_report(path_or_events):
     """Markdown-ish report (the PROFILE.md table format) for a
     telemetry.jsonl path or a pre-loaded event list."""
@@ -488,6 +553,7 @@ def render_report(path_or_events):
     lines.extend(_xla_section(s))
     lines.extend(_graph_section(s))
     lines.extend(_resilience_section(s))
+    lines.extend(_elasticity_section(s))
     if s["hangs"]:
         lines.append("")
         lines.append(f"!! {len(s['hangs'])} hang dump(s) recorded:")

@@ -246,9 +246,10 @@ class BaseTrainer:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from imaginaire_tpu.parallel.mesh import get_mesh
+            from imaginaire_tpu.parallel.sharding import assemble_global
 
-            return jax.device_put(state,
-                                  NamedSharding(get_mesh(), P()))
+            return assemble_global(state,
+                                   NamedSharding(get_mesh(), P()))
         return state
 
     def _constrain_state(self, state):
@@ -902,9 +903,16 @@ class BaseTrainer:
 
         logdir = cfg_get(self.cfg, "logdir", ".")
         verify = resilience.resilience_settings(self.cfg)["verify_on_load"]
-        target = ({"state": self.state,
+        # restore-structure donor: the live state, or — after an
+        # elastic rebind dropped it — the abstract template captured
+        # from it (ISSUE 11). Orbax only needs per-leaf shape/dtype
+        # plus the tree structure; without a donor the no-target path
+        # returns nested dicts and the optimizer NamedTuples are lost.
+        template = self.state if self.state is not None else getattr(
+            self, "_elastic_state_template", None)
+        target = ({"state": template,
                    "meta": {"epoch": 0, "iteration": 0}}
-                  if self.state is not None else None)
+                  if template is not None else None)
         # an in-flight async save must commit before we read anything back
         ckpt_lib.wait_for_pending_checkpoint()
         if checkpoint_path is None:
@@ -976,6 +984,15 @@ class BaseTrainer:
                 self.state["vars_D"] = restored["vars_D"]
             if "ema_G" in restored:
                 self.state["ema_G"] = restored["ema_G"]
+        self._elastic_state_template = None  # structure donor consumed
+        if resume:
+            # mixed redistribution plan (ISSUE 13): leaves the
+            # RedistributionPlanner routed "gather" were carried live
+            # across the resize — overwrite the restored copies before
+            # the re-commit so the carried bytes (bit-identical to the
+            # emergency checkpoint by the planner's iteration guard)
+            # are what lands under the new shardings
+            self._apply_elastic_carry()
         self._reshard_restored_state(checkpoint_path)
         bn_path = str(checkpoint_path) + ".ema_bn.pkl"
         if os.path.exists(bn_path):
@@ -1002,6 +1019,14 @@ class BaseTrainer:
         from imaginaire_tpu.resilience import cluster
 
         if not cluster.is_active():
+            return payload, checkpoint_path
+        if cluster.membership_epoch() > 0:
+            # post-resize membership (ISSUE 13): the checkpoint to
+            # resume from was already agreed cluster-wide by the
+            # ResizePlan, and restores are now legitimately asymmetric
+            # — survivors on the live-gather route never call
+            # load_checkpoint, so a joiner voting here would wait on
+            # peers that are already training and desync the pod
             return payload, checkpoint_path
         it_local = (ckpt_lib.parse_checkpoint_name(checkpoint_path)[1]
                     if checkpoint_path else -1)
@@ -1158,12 +1183,117 @@ class BaseTrainer:
         else:
             # the restored leaves are host numpy (load_checkpoint is
             # layout-agnostic by design); commit them to device arrays
-            # jax owns before the first post-restore step — the step
-            # programs donate their state argument, and donation
-            # semantics for borrowed numpy buffers are the backend's
-            # call, not a contract. One explicit transfer here keeps
-            # resume on the same committed-state footing as init_state.
-            self.state = jax.device_put(self.state)
+            # jax OWNS before the first post-restore step. A plain
+            # ``device_put`` is not enough: on the CPU backend it
+            # zero-copy-aliases an aligned numpy buffer, and the step
+            # programs DONATE their state argument — freeing a buffer
+            # numpy still owns is a use-after-free. ``jnp.array``
+            # (copy=True by default) guarantees an owned buffer.
+            import jax.numpy as jnp
+
+            self.state = jax.tree_util.tree_map(jnp.array, self.state)
+
+    def set_elastic_carry(self, carry):
+        """Stash the gather-routed leaves a ``RedistributionPlanner``
+        snapshot carried across the resize; the next resuming
+        ``load_checkpoint`` splices them over the restored tree."""
+        self._elastic_carry = dict(carry) if carry else None
+
+    def _apply_elastic_carry(self):
+        """Overwrite restored leaves with their carried live values
+        (keyed by ``jax.tree_util.keystr`` path). Returns the number of
+        leaves spliced. One-shot: the carry is consumed either way."""
+        carry = getattr(self, "_elastic_carry", None)
+        self._elastic_carry = None
+        if not carry or self.state is None:
+            return 0
+        applied = [0]
+
+        def _splice(path, leaf):
+            key = jax.tree_util.keystr(path)
+            if key in carry:
+                applied[0] += 1
+                return carry[key]
+            return leaf
+
+        self.state = jax.tree_util.tree_map_with_path(_splice, self.state)
+        return applied[0]
+
+    def elastic_recommit(self, carry, iteration, epoch):
+        """All-gather elastic restore (ISSUE 13): every state leaf was
+        carried across the resize as an owned host copy — rebuild the
+        tree from the rebind template's STRUCTURE and commit it under
+        the new world's shardings without touching the checkpoint (the
+        downtime win the RedistributionPlanner exists for). The
+        partition sidecar + runstate still come from the pointed
+        checkpoint so batch-offset resume and reshard telemetry match
+        the checkpoint route bit for bit."""
+        template = getattr(self, "_elastic_state_template", None)
+        if template is None:
+            raise RuntimeError(
+                "elastic_recommit needs the rebind template — call "
+                "elastic_rebind() first")
+
+        def _rebuild(path, leaf):
+            key = jax.tree_util.keystr(path)
+            if key not in carry:
+                raise KeyError(
+                    f"elastic_recommit: leaf {key} missing from the "
+                    f"carry — the planner routed it 'gather' but no "
+                    f"snapshot landed")
+            return carry[key]
+
+        self.state = jax.tree_util.tree_map_with_path(_rebuild, template)
+        self.current_iteration = int(iteration)
+        self.current_epoch = int(epoch)
+        self._elastic_state_template = None
+        checkpoint_path = ckpt_lib.latest_checkpoint_path(
+            cfg_get(self.cfg, "logdir", "."))
+        if checkpoint_path is not None:
+            self._restore_runstate(checkpoint_path)
+        self._reshard_restored_state(checkpoint_path)
+        print(f"Done with the elastic re-commit (iteration "
+              f"{self.current_iteration}, no checkpoint round-trip).")
+        return True
+
+    def elastic_rebind(self):
+        """Rebind the trainer to a freshly resized pod (ISSUE 11).
+
+        Called by the supervise loop AFTER ``elastic.apply`` tore the
+        old distributed runtime down and the new mesh is installed. The
+        old state arrays lived on backends that no longer exist, so
+        ``self.state`` drops to None — an abstract shape/dtype template
+        keeps its tree structure so the next ``load_checkpoint``
+        restores into it (host numpy, layout-agnostic) and
+        ``_reshard_restored_state`` commits the optimizer/EMA shards
+        under the new world's NamedShardings (the PR-6 reshard-on-load,
+        not a second reshard path). Every ledgered step program is
+        retraced under ``retrace('elastic_resize')``: the executables
+        baked the dead world's device ids into their bindings, and the
+        named retrace keeps the recompile tripwire quiet."""
+        from imaginaire_tpu.telemetry import xla_obs
+
+        self.partition = PartitionPlan(self.cfg)
+        self._state_shardings = None
+        # the state's tree STRUCTURE must survive the rebind: the
+        # no-target restore hands back plain nested dicts, and optax
+        # update() needs its NamedTuples (ScaleByAdamState.mu) back.
+        # An abstract shape/dtype template costs no memory and reads
+        # only aval metadata — safe even though the arrays' backend is
+        # already gone.
+        self._elastic_state_template = jax.tree_util.tree_map(
+            lambda x: (jax.ShapeDtypeStruct(x.shape, x.dtype)
+                       if hasattr(x, "shape") and hasattr(x, "dtype")
+                       else x),
+            self.state) if self.state is not None else None
+        self.state = None
+        self._ema_batch_stats = None  # device arrays of the dead world
+        retraced = []
+        for name, value in vars(self).items():
+            if isinstance(value, xla_obs.CompiledProgram):
+                value.retrace("elastic_resize")
+                retraced.append(value.label)
+        return retraced
 
     # ------------------------------------------------------------ inference
 

@@ -46,6 +46,7 @@ Fault tolerance (ISSUE 7, ``resilience/``):
 
 from __future__ import annotations
 
+import contextlib
 import os
 import re
 
@@ -71,6 +72,39 @@ def _async_checkpointer():
     if _ASYNC_CKPT is None:
         _ASYNC_CKPT = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
     return _ASYNC_CKPT
+
+
+def _align_orbax_barrier_counters():
+    """Pin orbax's per-process barrier counters before a collective save.
+
+    Orbax suffixes its internal barrier keys (``create_tmp_directory:…``,
+    async-save finalization, …) with PER-PROCESS ``itertools.count()``
+    values from ``orbax.checkpoint.multihost.counters`` and asserts via
+    ``sync_global_devices`` that every process computed the same key.
+    That assumes uniform save history — which elastic membership breaks
+    (ISSUE 13): a host that rejoined mid-run has saved fewer checkpoints
+    than the survivors, so at the next collective save its counter (say
+    ``.1``) disagrees with theirs (``.4``) and the whole pod dies with
+    ``sync_global_devices name mismatch``.
+
+    The counters carry no information for us: saves are already
+    serialized pod-wide by the named ``ckpt_enter``/``ckpt_commit``
+    timed barriers, each save targets a unique directory name, and
+    ``wait_for_pending_checkpoint`` drains any in-flight async commit
+    before the next dispatch — so resetting the counters between saves
+    cannot collide two concurrent barriers. Resetting (rather than
+    patching the accessors) keeps orbax's own uniqueness-within-a-save
+    behavior intact while making the sequence identical everywhere."""
+    import itertools
+
+    try:
+        from orbax.checkpoint.multihost import counters as _counters
+    except Exception:  # pragma: no cover — older orbax layouts
+        return
+    for attr in ("_tmp_directory_counter", "_async_save_counter",
+                 "_composite_save_counter"):
+        if hasattr(_counters, attr):
+            setattr(_counters, attr, itertools.count())
 
 
 def checkpoint_name(epoch, iteration):
@@ -140,6 +174,10 @@ def save_checkpoint(logdir, state, epoch, iteration, max_to_keep=None,
     from imaginaire_tpu.resilience import cluster
 
     cluster.timed_barrier("ckpt_enter", tag=name)
+    # Everyone is now entering THIS save together — align orbax's
+    # per-process barrier counters so elastic members with different
+    # save histories derive identical collective keys (ISSUE 13).
+    _align_orbax_barrier_counters()
 
     def _write_pointer():
         if is_master():
@@ -501,6 +539,41 @@ def gc_checkpoints(logdir, max_to_keep, protect=()):
 # -------------------------------------------------------------- restore
 
 
+@contextlib.contextmanager
+def _no_restore_barrier():
+    """Suppress orbax's end-of-restore process sync for the duration.
+
+    ``Checkpointer.restore`` closes with ``sync_global_processes`` — an
+    UNTIMED ``sync_global_devices`` psum over every global device
+    through the CPU gloo layer. In an elastic pod (ISSUE 13) restores
+    are legitimately asymmetric: a joiner restores the published
+    checkpoint at startup while the survivors re-commit their live
+    state and never touch orbax, so the joiner's barrier waits 30s for
+    gloo contexts no peer will ever create and the restore dies with
+    ``DEADLINE_EXCEEDED`` — and even when every member restores, a
+    fallback scan that walks a different number of candidates on one
+    host leaves that host's collective sequence offset from its peers,
+    which surfaces later as a wedged/aborted all-device sync at the
+    next checkpoint save. Restore is read-only, so the barrier guards
+    nothing; pod-wide resume agreement is the KV-store consensus vote
+    (timed, and it NAMES the absent process). Saves keep their sync:
+    the pre-finalize barrier is what stops the primary from renaming
+    the tmp directory while peers are still writing."""
+    from orbax.checkpoint import checkpointer as _ocp_checkpointer
+
+    mh = _ocp_checkpointer.multihost
+    orig = mh.sync_global_processes
+
+    def _skip(name, **kwargs):
+        return None
+
+    mh.sync_global_processes = _skip
+    try:
+        yield
+    finally:
+        mh.sync_global_processes = orig
+
+
 def _host_template(target):
     """A host-numpy zeros pytree with ``target``'s structure: what
     orbax needs from ``item`` is the tree structure (optimizer
@@ -544,10 +617,35 @@ def load_checkpoint(path, target=None, verify=True):
 
         verify_files(os.path.abspath(path),
                      (integrity or {}).get("files"), context=str(path))
-    with telemetry.span("ckpt_load"), ocp.PyTreeCheckpointer() as ckpt:
+    with telemetry.span("ckpt_load"), _no_restore_barrier(), \
+            ocp.PyTreeCheckpointer() as ckpt:
         if target is not None:
-            payload = ckpt.restore(os.path.abspath(path),
-                                   item=_host_template(target))
+            # force host-numpy restore here too (ISSUE 11): without
+            # restore args orbax replays the SAVED shardings from the
+            # sharding file — fine when the topology matches, a
+            # ``ValueError: sharding ... Got None`` when it does not
+            # (an elastic pod restoring a checkpoint written by a
+            # world whose devices no longer exist). The item keeps the
+            # tree structure (optimizer namedtuples) and true shapes.
+            import numpy as np
+
+            item = _host_template(target)
+            restore_args = jax.tree_util.tree_map(
+                lambda x: (ocp.RestoreArgs(restore_type=np.ndarray)
+                           if hasattr(x, "shape") else ocp.RestoreArgs()),
+                item)
+            payload = ckpt.restore(os.path.abspath(path), item=item,
+                                   restore_args=restore_args)
+
+            def _item_shape(v, t):
+                # scalar zarr arrays come back shape-(1,) on the numpy
+                # restore path; the template remembers the true shape
+                if hasattr(t, "shape") and hasattr(v, "shape") \
+                        and tuple(v.shape) != tuple(t.shape):
+                    return np.asarray(v).reshape(tuple(t.shape))
+                return v
+
+            payload = jax.tree_util.tree_map(_item_shape, payload, item)
         else:
             # no target: force every array leaf to restore as host
             # numpy (ISSUE 8). Without restore args orbax replays the
@@ -641,6 +739,16 @@ def load_latest_verified(logdir, target=None, verify=True):
             _note_fallback(tm, cand, fallbacks, str(e))
             continue
         except Exception as e:  # noqa: BLE001 — truncated/unrestorable
+            if type(e).__name__ in ("XlaRuntimeError",
+                                    "JaxRuntimeError"):
+                # runtime/collective infrastructure failure, not
+                # evidence about THIS checkpoint's bytes: quarantining
+                # here would walk the fallback scan through every
+                # candidate and condemn a healthy logdir (ISSUE 13:
+                # seen as gloo context timeouts when a resize left the
+                # pod's collective layer wedged). Fail the restore
+                # loudly and leave the checkpoints alone.
+                raise
             errors.append(f"{cand}: {type(e).__name__}: {e}")
             quarantine_checkpoint(cand,
                                   reason=f"restore failed: "

@@ -52,7 +52,8 @@ from imaginaire_tpu.telemetry.report import (  # noqa: E402
 def check_health(summary, require_health=False, max_dg_breaches=0,
                  max_recompiles=0, mem_budget_frac=None,
                  max_fallbacks=0, max_temp_frac=None,
-                 max_graph_violations=0):
+                 max_graph_violations=0,
+                 max_resizes=None, min_world_size=None):
     """Return the list of failure strings for an aggregated summary."""
     failures = []
     health = summary.get("health") or {}
@@ -172,6 +173,30 @@ def check_health(summary, require_health=False, max_dg_breaches=0,
             + "; ".join(
                 f"barrier {e.get('barrier')} absent {e.get('absent')}"
                 for e in res.get("desync_events", [])[:3]))
+    # elastic resizes (ISSUE 13): unlimited by default — a pod that
+    # reshapes around preemptions is the machinery WORKING; gate only
+    # when the caller budgets them (a drill expecting exactly N, or a
+    # prod run where ANY resize should page someone)
+    resizes = res.get("elastic_resizes", 0)
+    if max_resizes is not None and resizes > max_resizes:
+        shapes = [f"{e.get('old_world')}->{e.get('new_world')}"
+                  for e in res.get("resize_events", [])]
+        failures.append(
+            f"{resizes} elastic resize(s) (allowed {max_resizes})"
+            + (f": {shapes[:4]}" if shapes else ""))
+    # world-size floor (ISSUE 13): an elastic pod may legitimately
+    # shrink, but never below the operator's capacity floor — fail if
+    # any resize landed under it (reads the elastic/resize meta events)
+    if min_world_size is not None:
+        dips = [e for e in res.get("resize_events", [])
+                if e.get("new_world") is not None
+                and int(e["new_world"]) < min_world_size]
+        if dips:
+            shapes = [f"{e.get('old_world')}->{e.get('new_world')}"
+                      for e in dips]
+            failures.append(
+                f"pod resized below --min-world-size {min_world_size}: "
+                f"{shapes[:4]}")
     if require_health and not health.get("has_health_counters"):
         failures.append(
             "no health/* counters in the run (diagnostics disabled or "
@@ -235,6 +260,15 @@ def main(argv=None):
                          "(resilience/ckpt_fallbacks; default 0 — "
                          "chaos legs that corrupt on purpose pass 1). "
                          "Resume-divergence events always fail.")
+    ap.add_argument("--max-resizes", type=int, default=None,
+                    help="tolerated elastic mesh resizes "
+                         "(elastic/resizes counter; default: "
+                         "unlimited — resizing around peer loss is the "
+                         "machinery working, not a failure)")
+    ap.add_argument("--min-world-size", type=int, default=None,
+                    help="fail when any elastic resize landed below "
+                         "this world size (reads elastic/resize meta "
+                         "events; default: no floor)")
     ap.add_argument("--hosts", action="store_true",
                     help="aggregate every per-process telemetry file "
                          "(telemetry.jsonl + telemetry.jsonl.p*) of a "
@@ -263,7 +297,9 @@ def main(argv=None):
                             mem_budget_frac=args.mem_budget_frac,
                             max_fallbacks=args.max_fallbacks,
                             max_temp_frac=args.max_temp_frac,
-                            max_graph_violations=args.max_graph_violations)
+                            max_graph_violations=args.max_graph_violations,
+                            max_resizes=args.max_resizes,
+                            min_world_size=args.min_world_size)
     health = summary.get("health") or {}
     xla = summary.get("xla") or {}
     res = summary.get("resilience") or {}
@@ -302,6 +338,8 @@ def main(argv=None):
                 "resume_divergence": len(res.get("divergence_events",
                                                  [])),
                 "corrupt_flow_shards": res.get("corrupt_flow_shards", 0),
+                "elastic_resizes": res.get("elastic_resizes", 0),
+                "resize_downtime_ms": res.get("resize_downtime_ms"),
             },
         }, indent=1, default=str))
     elif failures:
@@ -340,7 +378,9 @@ def _main_hosts(args):
                                 max_fallbacks=args.max_fallbacks,
                                 max_temp_frac=args.max_temp_frac,
                                 max_graph_violations=
-                                args.max_graph_violations)
+                                args.max_graph_violations,
+                                max_resizes=args.max_resizes,
+                                min_world_size=args.min_world_size)
         verdicts[label] = {"path": fpath, "healthy": not failures,
                            "failures": failures}
         any_fail = any_fail or bool(failures)

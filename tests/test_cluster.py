@@ -350,24 +350,34 @@ class TestPerHostRunstate:
         got = runstate.read_runstate(ck, process_index=4)
         assert got["iteration"] == 3
 
-    def test_quarantine_moves_per_host_sidecars(self, tmp_path):
+    def test_quarantine_moves_per_host_sidecars(self, tmp_path,
+                                                monkeypatch):
+        from imaginaire_tpu.parallel import mesh
         from imaginaire_tpu.resilience.integrity import (
             quarantine_checkpoint,
             sidecar_files,
         )
 
+        # a live 3-process world: p1/p2 sidecars travel with the
+        # quarantine, but a p5 sidecar is an elastic-shrink orphan
+        # (ISSUE 11) and must be left behind for GC
+        monkeypatch.setattr(mesh, "get_world_size", lambda: 3)
         ck = tmp_path / "epoch_00000_iteration_000000002_checkpoint"
         ck.mkdir()
         (ck / "data").write_bytes(b"x" * 64)
         for suffix in (".runstate.json", ".runstate.p1.json",
-                       ".runstate.p2.json", ".integrity.json"):
+                       ".runstate.p2.json", ".runstate.p5.json",
+                       ".integrity.json"):
             (tmp_path / (ck.name + suffix)).write_text("{}")
-        assert len(sidecar_files(str(ck))) == 4
+        assert len(sidecar_files(str(ck))) == 5
         target = quarantine_checkpoint(str(ck), reason="test")
         assert target and target.endswith(".corrupt")
         assert os.path.exists(target + ".runstate.p1.json")
         assert os.path.exists(target + ".runstate.p2.json")
         assert not os.path.exists(str(ck) + ".runstate.p1.json")
+        # the orphan stayed put and did NOT follow the rename
+        assert os.path.exists(str(ck) + ".runstate.p5.json")
+        assert not os.path.exists(target + ".runstate.p5.json")
 
 
 # ------------------------------- collectives: single vs multi-process
@@ -674,3 +684,92 @@ class TestPersistentCachePolicy:
         metas = [e for e in events
                  if e.get("name") == "xla/persistent_cache_disabled"]
         assert metas and metas[0]["mode"] == "off"
+
+
+# -------------------------------------------- heartbeat epoch scoping
+
+
+class TestHeartbeatEpochScoping:
+    """ISSUE 13 satellite: after a resize the membership changes, and a
+    departed host's final stamp must not report it as stalled forever —
+    heartbeat keys are scoped to the membership epoch."""
+
+    @pytest.fixture(autouse=True)
+    def _epoch_reset(self):
+        yield
+        cluster.set_membership_epoch(None)
+
+    def test_heartbeat_key_forms(self):
+        assert cluster.heartbeat_key(2, epoch=0) == "hb/p2"
+        assert cluster.heartbeat_key(2, epoch=3) == "hb/e3/p2"
+        cluster.set_membership_epoch(1)
+        assert cluster.heartbeat_key(0) == "hb/e1/p0"
+        cluster.set_membership_epoch(None)
+        assert cluster.heartbeat_key(0) == "hb/p0"
+
+    def test_epoch_from_env(self, monkeypatch):
+        monkeypatch.setenv("IMAGINAIRE_ELASTIC_GENERATION", "2")
+        cluster.set_membership_epoch(None)
+        assert cluster.membership_epoch() == 2
+        assert cluster.heartbeat_key(1) == "hb/e2/p1"
+
+    def test_departed_hosts_stale_stamp_ignored(self, two_proc_client):
+        import time
+
+        now = time.time()
+        # the dead host's LAST stamp, written before the shrink under
+        # the old membership — without scoping it reads stalled forever
+        two_proc_client.kv["hb/p1"] = json.dumps({"t": now - 9999,
+                                                  "step": 4})
+        two_proc_client.kv["hb/e1/p0"] = json.dumps({"t": now,
+                                                     "step": 9})
+        two_proc_client.kv["hb/e1/p1"] = json.dumps({"t": now,
+                                                     "step": 9})
+        cluster.set_membership_epoch(1)
+        status = cluster.peer_status(stale_after_s=60)
+        assert status[0]["stalled"] is False
+        assert status[1]["stalled"] is False
+        assert cluster.stalled_peers(stale_after_s=60) == []
+
+    def test_epoch_entries_invisible_at_epoch_zero(self,
+                                                   two_proc_client):
+        import time
+
+        now = time.time()
+        two_proc_client.kv["hb/p0"] = json.dumps({"t": now, "step": 1})
+        # a fresh stamp under a future epoch is NOT this membership's
+        two_proc_client.kv["hb/e1/p1"] = json.dumps({"t": now,
+                                                     "step": 1})
+        status = cluster.peer_status(stale_after_s=60)
+        assert status[1]["t"] is None and status[1]["stalled"] is True
+
+    def test_old_epoch_invisible_at_new_epoch(self, two_proc_client):
+        import time
+
+        now = time.time()
+        two_proc_client.kv["hb/e1/p0"] = json.dumps({"t": now,
+                                                     "step": 2})
+        two_proc_client.kv["hb/e1/p1"] = json.dumps({"t": now,
+                                                     "step": 2})
+        cluster.set_membership_epoch(2)
+        status = cluster.peer_status(stale_after_s=60)
+        assert status[0]["t"] is None and status[1]["t"] is None
+
+
+class TestRankCacheSurvivesTeardown:
+    def test_get_rank_falls_back_in_teardown_window(self, monkeypatch):
+        from imaginaire_tpu.parallel import mesh
+
+        assert mesh.get_rank() == 0  # primes the caches
+        assert mesh.get_world_size() == 1
+
+        def _boom():
+            raise RuntimeError("Unable to initialize backend 'cpu'")
+
+        monkeypatch.setattr(mesh.jax, "process_index", _boom)
+        monkeypatch.setattr(mesh.jax, "process_count", _boom)
+        # the elastic teardown window (ISSUE 13): the backend cannot
+        # rebuild, but master-gated prints must still resolve identity
+        assert mesh.get_rank() == 0
+        assert mesh.is_master() is True
+        assert mesh.get_world_size() >= 1

@@ -1,0 +1,952 @@
+"""Elastic pods (ISSUE 11): the resize machinery in isolation.
+
+Covers the pieces the 3->2->3 chaos drill (dryrun leg
+``spade_elastic``) exercises end-to-end: ``ResizePlan`` consensus
+derivation (shrink votes over the KV store, deterministic grow plans),
+``fit_mesh_shape`` re-derivation across world sizes, the
+block-contiguous loader split's world-size invariance, barrier-epoch
+negotiation on (re)join, orphan runstate sidecars after a shrink, the
+joiner rendezvous files, and the health gate's ``--max-resizes``
+budget. Everything runs single-process against the same fake
+coordination-service KV client as ``test_cluster.py``."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from imaginaire_tpu.config import AttrDict
+from imaginaire_tpu.resilience import cluster, elastic
+from imaginaire_tpu.resilience.cluster import ClusterDesyncError
+from imaginaire_tpu.resilience.elastic import (
+    ElasticCoordinator,
+    ElasticResize,
+    ResizePlan,
+)
+
+
+class FakeBarrierTimeout(Exception):
+    pass
+
+
+class FakeClient:
+    """KV + barrier surface of the distributed-runtime client (same
+    shape as the one in test_cluster.py)."""
+
+    def __init__(self, n, present=None):
+        self.n = n
+        self.present = set(range(n)) if present is None else set(present)
+        self.kv = {}
+        self.barrier_calls = []
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        if not allow_overwrite and key in self.kv:
+            raise RuntimeError(f"key exists: {key}")
+        self.kv[key] = value
+
+    def key_value_dir_get(self, prefix):
+        return sorted((k, v) for k, v in self.kv.items()
+                      if k.startswith(prefix))
+
+    def key_value_delete(self, key):
+        self.kv.pop(key, None)
+
+    def wait_at_barrier(self, barrier_id, timeout_ms, process_ids=None):
+        self.barrier_calls.append(barrier_id)
+        if self.present != set(range(self.n)):
+            raise FakeBarrierTimeout(
+                f"DEADLINE_EXCEEDED: Barrier timed out. Id: "
+                f"{barrier_id}")
+
+
+@pytest.fixture(autouse=True)
+def _reset_cluster():
+    cluster._BARRIER_EPOCH.clear()
+    yield
+    cluster.set_client_for_testing(None)
+    cluster._SETTINGS = None
+    cluster._BARRIER_EPOCH.clear()
+
+
+def _elastic_cfg(**overrides):
+    ecfg = dict({"enabled": True, "min_world_size": 2,
+                 "resize_timeout_s": 0.3}, **overrides)
+    return AttrDict({"resilience": {"elastic": ecfg}})
+
+
+def _coordinator(tmp_path=None, env=None, **overrides):
+    if env is not None:
+        env.setdefault("IMAGINAIRE_ELASTIC_BASE_COORDINATOR",
+                       "127.0.0.1:6000")
+        for key, value in env.items():
+            os.environ[key] = value
+    co = ElasticCoordinator(
+        _elastic_cfg(**overrides),
+        logdir=str(tmp_path) if tmp_path is not None else None)
+    return co
+
+
+@pytest.fixture
+def base_env(monkeypatch):
+    monkeypatch.setenv("IMAGINAIRE_ELASTIC_BASE_COORDINATOR",
+                       "127.0.0.1:6000")
+    monkeypatch.delenv("IMAGINAIRE_ELASTIC_GENERATION", raising=False)
+
+
+# ------------------------------------------------------------ ResizePlan
+
+
+class TestResizePlan:
+    def test_json_round_trip(self):
+        plan = ResizePlan(
+            2, ["p0", "p1", "rejoin-p2"], "127.0.0.1:6034",
+            iteration=5, epoch=1, mesh_axes=["data", "model"],
+            mesh_shape=[6, 1], barrier_epochs={"psync": 7},
+            reason="grow", old_world=2, old_mesh_shape=[6, 1])
+        back = ResizePlan.from_json(plan.to_json())
+        assert back.generation == 2
+        assert back.members == ["p0", "p1", "rejoin-p2"]
+        assert back.coordinator == "127.0.0.1:6034"
+        assert back.iteration == 5 and back.epoch == 1
+        assert back.mesh_axes == ["data", "model"]
+        assert back.mesh_shape == [6, 1]
+        assert back.barrier_epochs == {"psync": 7}
+        assert back.reason == "grow"
+        assert back.old_world == 2 and back.old_mesh_shape == [6, 1]
+
+    def test_member_identity(self):
+        plan = ResizePlan(1, ["p0", "p2"], "h:1")
+        assert plan.world_size == 2
+        # a member's NEW process id is its index — survivor p2 becomes
+        # process 1 of the shrunken world, the old master stays master
+        assert plan.process_id_of("p0") == 0
+        assert plan.process_id_of("p2") == 1
+        assert plan.process_id_of("p1") is None
+
+    def test_defaults_round_trip(self):
+        back = ResizePlan.from_json(ResizePlan(1, ["p0"], "h:1").to_json())
+        assert back.mesh_shape is None and back.mesh_axes is None
+        assert back.iteration == -1 and back.reason == "shrink"
+
+
+# ------------------------------------------------- fit_mesh_shape rules
+
+
+class TestFitMeshShape:
+    def _cfg(self, shape, axes=("data", "model"), **extra):
+        return AttrDict({"parallel": dict({"mesh_shape": list(shape),
+                                           "axes": list(axes)}, **extra)})
+
+    def test_constant_mesh_survives_overprovision(self):
+        from imaginaire_tpu.parallel.mesh import fit_mesh_shape
+
+        # the drill's invariant: [6, 1] fits BOTH 3 procs x 3 devices
+        # (9, one idle each) and 2 procs x 3 devices (6, none idle) —
+        # the logical mesh, hence the math, never changes
+        for total in (9, 6):
+            axes, dims = fit_mesh_shape(self._cfg([6, 1]), total)
+            assert tuple(axes) == ("data", "model")
+            assert list(dims) == [6, 1]
+
+    def test_data_axis_shrinks_to_surviving_world(self):
+        from imaginaire_tpu.parallel.mesh import fit_mesh_shape
+
+        axes, dims = fit_mesh_shape(self._cfg([4, 1]), 3)
+        assert list(dims) == [3, 1]
+
+    def test_model_axis_collapse_warns(self, caplog):
+        from imaginaire_tpu.parallel.mesh import fit_mesh_shape
+
+        # (2, 2) on 2 surviving devices: ties collapse toward pure DP,
+        # the dead model axis warns (its partition rules go inert)
+        with caplog.at_level("WARNING"):
+            axes, dims = fit_mesh_shape(self._cfg([2, 2]), 2)
+        assert list(dims) == [2, 1]
+        assert any("model" in r.message for r in caplog.records)
+
+    def test_no_configured_shape_is_unconstrained(self):
+        from imaginaire_tpu.parallel.mesh import fit_mesh_shape
+
+        axes, dims = fit_mesh_shape(AttrDict({}), 5)
+        assert dims is None
+
+
+# ------------------------------------------------- shrink consensus
+
+
+class TestAgreeSurvivors:
+    def test_all_votes_collected(self):
+        client = FakeClient(3)
+        cluster.set_client_for_testing(client, process_index=0,
+                                       process_count=3)
+        # p1's vote is already in the KV store when p0 arrives
+        client.kv["elastic/shrink/1/p1"] = json.dumps(
+            {"it": 7, "ep": 0, "tok": "p1"})
+        votes = cluster.agree_survivors(
+            "shrink", 1, {"it": 9, "ep": 0, "tok": "p0"}, [0, 1],
+            timeout_s=2.0)
+        assert sorted(votes) == [0, 1]
+        assert votes[1]["it"] == 7
+        # own vote was published for the peer's poll
+        assert "elastic/shrink/1/p0" in client.kv
+
+    def test_timeout_names_missing_survivor(self):
+        client = FakeClient(3)
+        cluster.set_client_for_testing(client, process_index=0,
+                                       process_count=3)
+        with pytest.raises(ClusterDesyncError) as exc:
+            cluster.agree_survivors("shrink", 1, {"it": 9}, [0, 1],
+                                    timeout_s=0.15, poll_s=0.02)
+        assert "[1]" in str(exc.value)
+
+    def test_single_survivor_short_circuits(self):
+        votes = cluster.agree_survivors("shrink", 1, {"it": 3}, [0],
+                                        timeout_s=0.1)
+        assert votes == {0: {"it": 3}}
+
+
+class TestCoordinatorShrink:
+    def test_can_shrink_gates(self, base_env):
+        client = FakeClient(3)
+        cluster.set_client_for_testing(client, process_index=0,
+                                       process_count=3)
+        co = _coordinator()
+        assert co.can_shrink([2]) is True
+        # the master carries the KV store: its death ends the pod
+        assert co.can_shrink([0, 2]) is False
+        # two deaths of three would leave the world below min_world_size=2
+        assert co.can_shrink([1, 2]) is False
+        assert co.can_shrink([]) is False
+        off = ElasticCoordinator(
+            AttrDict({"resilience": {"elastic": {"enabled": False}}}))
+        assert off.can_shrink([2]) is False
+
+    def test_port_schedule_is_deterministic(self, base_env):
+        co = _coordinator()
+        stride = co.settings["port_stride"]
+        assert co.coordinator_for(0) == "127.0.0.1:6000"
+        assert co.coordinator_for(1) == f"127.0.0.1:{6000 + stride}"
+        assert co.coordinator_for(3) == f"127.0.0.1:{6000 + 3 * stride}"
+
+    def test_missing_base_coordinator_raises(self, monkeypatch):
+        monkeypatch.delenv("IMAGINAIRE_ELASTIC_BASE_COORDINATOR",
+                           raising=False)
+        monkeypatch.delenv("IMAGINAIRE_DIST_COORDINATOR", raising=False)
+        co = ElasticCoordinator(_elastic_cfg())
+        with pytest.raises(RuntimeError, match="coordinator"):
+            co.coordinator_for(1)
+
+    def test_plan_shrink_derivation(self, base_env, tmp_path):
+        client = FakeClient(3)
+        cluster.set_client_for_testing(client, process_index=0,
+                                       process_count=3)
+        client.kv["elastic/shrink/1/p1"] = json.dumps(
+            {"it": 4, "ep": 0, "tok": "p1"})
+        cluster._BARRIER_EPOCH["psync"] = 5
+        co = _coordinator(tmp_path)
+        plan = co.plan_shrink([2], iteration=6, epoch=0)
+        assert plan.generation == 1
+        assert plan.members == ["p0", "p1"]
+        stride = co.settings["port_stride"]
+        assert plan.coordinator == f"127.0.0.1:{6000 + stride}"
+        # the agreed iteration is the MINIMUM valid vote — the
+        # checkpoint every survivor provably has
+        assert plan.iteration == 4
+        assert plan.reason == "shrink" and plan.old_world == 3
+        assert plan.barrier_epochs["psync"] == 5
+        # p0 is the min survivor: it published the topology file the
+        # future joiners rendezvous on
+        topo = ResizePlan.from_json(
+            open(co.topology_path()).read())
+        assert topo.members == plan.members
+        assert topo.generation == 1
+
+
+# ------------------------------------------------------------- grow
+
+
+class TestCoordinatorGrow:
+    def test_join_request_round_trip(self, base_env, tmp_path):
+        co = _coordinator(tmp_path)
+        assert co.check_join_requests() == []
+        elastic.request_join(tmp_path, "rejoin-p2")
+        elastic.request_join(tmp_path, "aaa")
+        assert co.check_join_requests() == ["aaa", "rejoin-p2"]
+        co.consume_join_requests(["aaa", "rejoin-p2"])
+        assert co.check_join_requests() == []
+
+    def test_announce_and_poll_grow(self, base_env, tmp_path):
+        client = FakeClient(2)
+        cluster.set_client_for_testing(client, process_index=0,
+                                       process_count=2)
+        co = _coordinator(tmp_path)
+        rec = co.announce_grow(12, ["rejoin-p2"])
+        assert rec == {"target": 12, "joiners": ["rejoin-p2"],
+                       "generation": 1}
+        # re-announcing the same joiner set is a no-op (one decision
+        # per sync step, not one per poll)
+        assert co.announce_grow(14, ["rejoin-p2"]) is None
+        got = co.poll_grow()
+        assert got["target"] == 12 and got["joiners"] == ["rejoin-p2"]
+
+    def test_plan_grow_membership(self, base_env, tmp_path):
+        client = FakeClient(2)
+        cluster.set_client_for_testing(client, process_index=0,
+                                       process_count=2)
+        cluster._BARRIER_EPOCH["ckpt_enter"] = 3
+        co = _coordinator(tmp_path)
+        plan = co.plan_grow(["zz-nonce", "aa-nonce"], iteration=12,
+                            epoch=2)
+        # survivors keep their ids; joiners take the NEW tail ids in
+        # sorted-nonce order — every member derives this identically
+        assert plan.members == ["p0", "p1", "aa-nonce", "zz-nonce"]
+        assert plan.process_id_of("aa-nonce") == 2
+        assert plan.generation == 1 and plan.reason == "grow"
+        assert plan.iteration == 12 and plan.epoch == 2
+        assert plan.barrier_epochs["ckpt_enter"] == 3
+
+    def test_wait_for_join_env_contract(self, base_env, tmp_path,
+                                        monkeypatch):
+        for var in ("IMAGINAIRE_DIST_COORDINATOR",
+                    "IMAGINAIRE_DIST_NUM_PROCESSES",
+                    "IMAGINAIRE_DIST_PROCESS_ID", "IMAGINAIRE_ELASTIC"):
+            monkeypatch.setenv(var, "sentinel")
+        monkeypatch.setenv("IMAGINAIRE_ELASTIC_GENERATION", "0")
+        co = _coordinator(tmp_path)
+        plan = ResizePlan(2, ["p0", "p1", "rejoin-p2"],
+                          "127.0.0.1:6034", iteration=5,
+                          barrier_epochs={"psync": 9}, reason="grow")
+        co.publish_topology(plan)
+        got = elastic.wait_for_join(tmp_path, "rejoin-p2",
+                                    timeout_s=2.0, poll_s=0.01)
+        assert got.generation == 2
+        assert os.environ["IMAGINAIRE_DIST_PROCESS_ID"] == "2"
+        assert os.environ["IMAGINAIRE_DIST_NUM_PROCESSES"] == "3"
+        assert os.environ["IMAGINAIRE_DIST_COORDINATOR"] == \
+            "127.0.0.1:6034"
+        assert os.environ["IMAGINAIRE_ELASTIC"] == "1"
+        assert os.environ["IMAGINAIRE_ELASTIC_GENERATION"] == "2"
+
+    def test_wait_for_join_times_out_unlisted(self, base_env, tmp_path):
+        co = _coordinator(tmp_path)
+        co.publish_topology(ResizePlan(1, ["p0", "p1"], "h:1"))
+        with pytest.raises(TimeoutError, match="not granted"):
+            elastic.wait_for_join(tmp_path, "somebody-else",
+                                  timeout_s=0.1, poll_s=0.02)
+
+
+# ------------------------------------------------- barrier negotiation
+
+
+class TestBarrierEpochNegotiation:
+    def test_export_snapshots_counters(self):
+        cluster._BARRIER_EPOCH.update({"psync": 4, "ckpt_enter": 2})
+        snap = cluster.export_barrier_epochs()
+        assert snap == {"psync": 4, "ckpt_enter": 2}
+        snap["psync"] = 99  # a copy, not the live table
+        assert cluster._BARRIER_EPOCH["psync"] == 4
+
+    def test_adopt_is_max_merge(self):
+        cluster._BARRIER_EPOCH.update({"psync": 4})
+        # a joiner fast-forwards to the cluster snapshot...
+        cluster.adopt_barrier_epochs({"psync": 9, "ckpt_enter": 3})
+        assert cluster._BARRIER_EPOCH["psync"] == 9
+        assert cluster._BARRIER_EPOCH["ckpt_enter"] == 3
+        # ...but NEVER rewinds: a reused barrier id is poison
+        cluster.adopt_barrier_epochs({"psync": 2})
+        assert cluster._BARRIER_EPOCH["psync"] == 9
+
+    def test_adopt_survives_plan_json_keys(self):
+        # barrier epochs ride ResizePlan JSON — keys come back as str
+        plan = ResizePlan.from_json(ResizePlan(
+            1, ["p0"], "h:1", barrier_epochs={"psync": 6}).to_json())
+        cluster.adopt_barrier_epochs(plan.barrier_epochs)
+        assert cluster._BARRIER_EPOCH["psync"] == 6
+
+
+# ---------------------------------------- block-contiguous loader split
+
+
+class _IndexDataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return {"x": np.asarray([i])}
+
+
+class TestLoaderBlockSplit:
+    def _orders(self, world, n=24, g=6, shuffle=True):
+        from imaginaire_tpu.data import loader as loader_mod
+
+        per_host = []
+        for rank in (range(world)):
+            dl = loader_mod.DataLoader(_IndexDataset(n), batch_size=1,
+                                       shuffle=shuffle, seed=3,
+                                       global_batch_size=g)
+            dl.set_epoch(1)
+            loader_mod.get_world_size = lambda: world
+            loader_mod.get_rank = lambda r=rank: r
+            try:
+                per_host.append(dl._order())
+            finally:
+                from imaginaire_tpu.parallel.mesh import (
+                    get_rank,
+                    get_world_size,
+                )
+
+                loader_mod.get_rank = get_rank
+                loader_mod.get_world_size = get_world_size
+        return per_host
+
+    def _global_batches(self, world, **kw):
+        per_host = self._orders(world, **kw)
+        share = per_host[0].size // (24 // 6)
+        batches = []
+        for k in range(24 // 6):
+            rows = [h[k * share:(k + 1) * share] for h in per_host]
+            batches.append(np.concatenate(rows))
+        return batches
+
+    def test_global_batch_world_invariant(self):
+        # THE elastic bit-exactness property: global batch k is the
+        # same rows in the same mesh order at world 3, 2 and 1
+        b3 = self._global_batches(3)
+        b2 = self._global_batches(2)
+        b1 = self._global_batches(1)
+        for k in range(len(b3)):
+            assert np.array_equal(b3[k], b2[k])
+            assert np.array_equal(b3[k], b1[k])
+
+    def test_per_host_batch_follows_live_world(self):
+        from imaginaire_tpu.data import loader as loader_mod
+
+        dl = loader_mod.DataLoader(_IndexDataset(24), batch_size=1,
+                                   global_batch_size=6)
+        for world, share in ((3, 2), (2, 3), (1, 6)):
+            loader_mod.get_world_size = lambda w=world: w
+            try:
+                assert dl.batch_size == share
+                # epoch length is measured in GLOBAL batches — also
+                # world-invariant
+                assert len(dl) == 4
+            finally:
+                from imaginaire_tpu.parallel.mesh import get_world_size
+
+                loader_mod.get_world_size = get_world_size
+
+    def test_indivisible_world_floors_and_warns(self, caplog):
+        from imaginaire_tpu.data import loader as loader_mod
+
+        dl = loader_mod.DataLoader(_IndexDataset(24), batch_size=1,
+                                   global_batch_size=6)
+        loader_mod.get_world_size = lambda: 4
+        try:
+            with caplog.at_level("WARNING"):
+                assert dl.batch_size == 1
+                assert dl.batch_size == 1  # warned once per world
+        finally:
+            from imaginaire_tpu.parallel.mesh import get_world_size
+
+            loader_mod.get_world_size = get_world_size
+        warns = [r for r in caplog.records
+                 if "not divisible" in r.message]
+        assert len(warns) == 1
+
+
+# ------------------------------------------------ orphan runstate files
+
+
+class TestOrphanSidecars:
+    def _mk(self, tmp_path, indices, legacy=True):
+        ck = tmp_path / "epoch_00000_iteration_000000004_checkpoint"
+        ck.mkdir()
+        (ck / "data").write_bytes(b"x")
+        if legacy:
+            (tmp_path / (ck.name + ".runstate.json")).write_text(
+                json.dumps({"iteration": 4, "epoch": 0}))
+        for i in indices:
+            (tmp_path / (ck.name + f".runstate.p{i}.json")).write_text(
+                json.dumps({"iteration": 4, "epoch": 0, "p": i}))
+        return str(ck)
+
+    def test_runstate_index(self):
+        from imaginaire_tpu.resilience.integrity import runstate_index
+
+        # the legacy master sidecar has no index suffix — it is never
+        # an orphan candidate
+        assert runstate_index("x_checkpoint.runstate.json") is None
+        assert runstate_index("x_checkpoint.runstate.p3.json") == 3
+        assert runstate_index("x_checkpoint.integrity.json") is None
+
+    def test_orphans_against_explicit_world(self, tmp_path):
+        from imaginaire_tpu.resilience.integrity import orphan_sidecars
+
+        ck = self._mk(tmp_path, [1, 2, 5])
+        orphans = orphan_sidecars(ck, world_size=3)
+        assert [os.path.basename(o) for o in orphans] == [
+            "epoch_00000_iteration_000000004_checkpoint"
+            ".runstate.p5.json"]
+        assert orphan_sidecars(ck, world_size=6) == []
+
+    def test_read_runstate_warns_but_reads(self, tmp_path, caplog):
+        from imaginaire_tpu.resilience.runstate import read_runstate
+
+        ck = self._mk(tmp_path, [7])
+        with caplog.at_level("WARNING"):
+            rec = read_runstate(ck)
+        # the shrink leftover did not break resume — own record wins
+        assert rec["iteration"] == 4
+        assert any("orphan" in r.message for r in caplog.records)
+
+
+# ------------------------------------------- drain split / guard reset
+
+
+class TestDrainSplit:
+    def test_return_flagged_identifies_leavers(self):
+        client = FakeClient(2)
+        cluster.set_client_for_testing(client, process_index=0,
+                                       process_count=2)
+        client.kv["psync/5/p1"] = "1"
+        # survivors (p0) learn WHICH host is leaving — the elastic
+        # drain split keys off exactly this list
+        flag, flagged = cluster.coordinate_preemption(
+            5, False, timeout_s=5, return_flagged=True)
+        assert flag is True and flagged == [1]
+
+    def test_return_flagged_single_process(self):
+        flag, flagged = cluster.coordinate_preemption(
+            1, True, return_flagged=True)
+        assert flag is True and flagged == [0]
+        flag, flagged = cluster.coordinate_preemption(
+            1, False, return_flagged=True)
+        assert flag is False and flagged == []
+
+    def test_guard_reset_clears_drain(self):
+        from imaginaire_tpu.resilience.preemption import PreemptionGuard
+
+        guard = PreemptionGuard(deadline_s=0.0)
+        guard._triggered.set()
+        guard.signum = 15
+        assert guard.triggered
+        # the survivors committed the leaver's emergency checkpoint and
+        # keep training — a sticky flag would re-enter the drain at
+        # every later vote
+        guard.reset()
+        assert not guard.triggered and guard.signum is None
+
+
+# ---------------------------------------------- telemetry + health gate
+
+
+_STEP = {"kind": "counter", "name": "perf/imgs_per_sec", "value": 1.0,
+         "step": 1, "t": 0.0}
+
+
+def _resize_events(n, world_from=3, world_to=2):
+    events = []
+    for g in range(1, n + 1):
+        events.append({"kind": "meta", "name": "elastic/resize",
+                       "generation": g, "reason": "shrink",
+                       "old_world": world_from, "new_world": world_to,
+                       "iteration": 2 * g, "downtime_ms": 1500.0,
+                       "t": float(g)})
+        events.append({"kind": "counter",
+                       "name": "elastic/resizes",
+                       "value": float(g), "step": 2 * g, "t": float(g)})
+        events.append({"kind": "counter",
+                       "name": "elastic/downtime_ms",
+                       "value": 1500.0 * g, "step": 2 * g,
+                       "t": float(g)})
+    return events
+
+
+def _write_jsonl(path, events):
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+class TestResizeTelemetry:
+    def test_summarize_collects_resizes(self, tmp_path):
+        from imaginaire_tpu.telemetry.report import load_events, summarize
+
+        path = tmp_path / "telemetry.jsonl"
+        _write_jsonl(path, [_STEP] + _resize_events(2))
+        s = summarize(load_events(str(path)))
+        res = s["resilience"]
+        # counters are latest-value-as-total: 2 resizes, cumulative
+        # downtime — and every resize event is kept (meta dicts are
+        # last-wins, the list is not)
+        assert res["elastic_resizes"] == 2
+        assert res["resize_downtime_ms"] == pytest.approx(3000.0)
+        assert len(res["resize_events"]) == 2
+        assert res["resize_events"][0]["generation"] == 1
+
+
+class TestElasticGate:
+    def _gate(self, rundir, *extra):
+        script = os.path.join(os.path.dirname(__file__), "..",
+                              "scripts", "check_run_health.py")
+        return subprocess.run(
+            [sys.executable, script, str(rundir), *extra],
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+    def test_resizes_within_budget_pass(self, tmp_path):
+        _write_jsonl(tmp_path / "telemetry.jsonl",
+                     [_STEP] + _resize_events(2))
+        r = self._gate(tmp_path, "--max-resizes", "2")
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_resizes_over_budget_fail(self, tmp_path):
+        _write_jsonl(tmp_path / "telemetry.jsonl",
+                     [_STEP] + _resize_events(2))
+        r = self._gate(tmp_path, "--max-resizes", "1")
+        assert r.returncode != 0
+        assert "elastic" in r.stdout
+
+    def test_no_budget_ignores_resizes(self, tmp_path):
+        _write_jsonl(tmp_path / "telemetry.jsonl",
+                     [_STEP] + _resize_events(3))
+        r = self._gate(tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_hosts_mode_accepts_resized_pod(self, tmp_path):
+        # after a 3->2 shrink only p0/p1 keep writing — the per-host
+        # sweep must treat the recorded resize as the explanation for
+        # p2's silence, not a failure
+        _write_jsonl(tmp_path / "telemetry.jsonl.p0",
+                     [_STEP] + _resize_events(1))
+        _write_jsonl(tmp_path / "telemetry.jsonl.p1", [_STEP])
+        _write_jsonl(tmp_path / "telemetry.jsonl.p2", [_STEP])
+        r = self._gate(tmp_path, "--hosts", "--expect-hosts", "3",
+                       "--max-resizes", "1")
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_min_world_size_gate(self, tmp_path):
+        # a 3->2 shrink is fine at --min-world-size 2 and a failure
+        # at --min-world-size 3 (the pod dipped below the floor)
+        _write_jsonl(tmp_path / "telemetry.jsonl",
+                     [_STEP] + _resize_events(1))
+        ok = self._gate(tmp_path, "--min-world-size", "2")
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        bad = self._gate(tmp_path, "--min-world-size", "3")
+        assert bad.returncode != 0
+        assert "world" in bad.stdout
+
+
+# ------------------------------------------------- redistribution plan
+
+
+class _ShardedLeaf:
+    """A leaf whose sharding spans processes (a survivor only owns its
+    shard) — must route via the checkpoint."""
+
+    class _Sharding:
+        is_fully_replicated = False
+
+    def __init__(self, shape, dtype=np.float32):
+        self._a = np.zeros(shape, dtype)
+        self.sharding = self._Sharding()
+
+    @property
+    def size(self):
+        return self._a.size
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+
+def _plan(iteration=5, world=2):
+    return ResizePlan(1, [f"p{i}" for i in range(world)],
+                      "127.0.0.1:6017", iteration=iteration,
+                      reason="shrink", old_world=world + 1)
+
+
+class TestRedistributionPlanner:
+    def _state(self):
+        rng = np.random.RandomState(0)
+        return {
+            "vars_G": {"params": rng.rand(4, 3).astype(np.float32)},
+            "opt_G": {"mu": rng.rand(4, 3).astype(np.float32),
+                      "nu": rng.rand(4, 3).astype(np.float32)},
+            "ema_G": {"w": rng.rand(2, 5).astype(np.float32)},
+        }
+
+    def test_byte_accounting_matches_state_bytes_report(self):
+        from imaginaire_tpu.parallel.partition import state_bytes_report
+
+        state = self._state()
+        rp = elastic.RedistributionPlanner(_plan(iteration=5), 5, state)
+        report = state_bytes_report(state)
+        # the planner's total over the SAME subtrees equals the
+        # partition ledger's global_bytes — one accounting, two views
+        for key, rec in report.items():
+            sub = elastic.RedistributionPlanner(
+                _plan(iteration=5), 5, state[key])
+            assert sub.total_bytes == rec["global_bytes"], key
+        total = sum(v.size * v.dtype.itemsize
+                    for part in state.values()
+                    for v in part.values())
+        assert rp.total_bytes == total
+
+    def test_live_match_routes_gather(self):
+        state = self._state()
+        rp = elastic.RedistributionPlanner(_plan(iteration=5), 5, state)
+        assert rp.all_gather
+        assert rp.checkpoint_bytes == 0
+        assert rp.route_counts() == {"gather": 4, "checkpoint": 0}
+
+    def test_iteration_mismatch_routes_checkpoint(self):
+        # a heartbeat-staleness shrink resumes from the LAST checkpoint
+        # (plan.iteration -1): live leaves are ahead of it — carrying
+        # them would resume from unagreed state
+        state = self._state()
+        rp = elastic.RedistributionPlanner(_plan(iteration=-1), 5, state)
+        assert not rp.all_gather
+        assert rp.gather_bytes == 0
+        assert rp.route_counts()["checkpoint"] == 4
+
+    def test_cross_process_shard_routes_checkpoint(self):
+        state = self._state()
+        state["opt_G"]["mu"] = _ShardedLeaf((4, 3))
+        rp = elastic.RedistributionPlanner(_plan(iteration=5), 5, state)
+        assert not rp.all_gather
+        counts = rp.route_counts()
+        assert counts == {"gather": 3, "checkpoint": 1}
+        assert rp.checkpoint_bytes == 4 * 3 * 4
+
+    def test_empty_state_never_all_gather(self):
+        # a joiner has NO live state: nothing to carry, everything
+        # restores from the checkpoint
+        rp = elastic.RedistributionPlanner(_plan(iteration=5), 5, None)
+        assert not rp.all_gather
+        assert rp.total_bytes == 0
+
+    def test_snapshot_owns_copies(self):
+        state = self._state()
+        rp = elastic.RedistributionPlanner(_plan(iteration=5), 5, state)
+        carry = rp.snapshot(state)
+        assert len(carry) == 4
+        key = next(k for k in carry if "mu" in k)
+        state["opt_G"]["mu"][:] = -1.0
+        assert not np.any(carry[key] == -1.0)  # owned, not a view
+
+    def test_summary_shape(self):
+        state = self._state()
+        state["ema_G"]["w"] = _ShardedLeaf((2, 5))
+        rp = elastic.RedistributionPlanner(_plan(iteration=5), 5, state)
+        s = rp.summary()
+        assert s["redistributed_bytes"] == rp.total_bytes
+        assert s["gather_bytes"] + s["checkpoint_bytes"] == \
+            s["redistributed_bytes"]
+        assert s["gather_leaves"] == 3 and s["checkpoint_leaves"] == 1
+
+    def test_record_resize_carries_redistribution(self, tmp_path):
+        from imaginaire_tpu import telemetry
+        from imaginaire_tpu.telemetry import core as tcore
+
+        co = _coordinator(tmp_path, env={})
+        co.resizes = 1
+        old = tcore._TELEMETRY
+        tm = telemetry.configure(logdir=str(tmp_path), enabled=True,
+                                 sinks=["jsonl"], flush_every_n_steps=0)
+        try:
+            co.record_resize(_plan(), 1234.5, {"reinit_ms": 200.0},
+                             redistribution={"redistributed_bytes": 640,
+                                             "gather_bytes": 640,
+                                             "checkpoint_bytes": 0,
+                                             "gather_leaves": 5,
+                                             "checkpoint_leaves": 0})
+            tm.shutdown()
+        finally:
+            tcore._TELEMETRY = old
+        events = [json.loads(line) for line in
+                  open(os.path.join(tmp_path, "telemetry.jsonl"))]
+        meta = [e for e in events if e.get("name") == "elastic/resize"]
+        assert meta and meta[0]["redistribution"][
+            "redistributed_bytes"] == 640
+        counters = {e["name"]: e["value"] for e in events
+                    if e.get("kind") == "counter"}
+        assert counters["elastic/redistributed_bytes"] == 640
+
+
+class TestElasticityReport:
+    def test_report_has_elasticity_section(self, tmp_path):
+        from imaginaire_tpu.telemetry.report import render_report
+
+        events = [_STEP] + _resize_events(2)
+        events[1]["redistribution"] = {
+            "redistributed_bytes": 2048, "gather_bytes": 0,
+            "checkpoint_bytes": 2048, "gather_leaves": 0,
+            "checkpoint_leaves": 7}
+        events.append({"kind": "counter",
+                       "name": "elastic/redistributed_bytes",
+                       "value": 2048.0, "step": 2, "t": 2.0})
+        path = tmp_path / "telemetry.jsonl"
+        _write_jsonl(path, events)
+        text = render_report(str(path))
+        assert "## elasticity" in text
+        assert "resizes: 2" in text
+        assert "redistributed state bytes" in text
+        assert "via checkpoint reshard" in text
+
+
+# ----------------------------------------------- runstate epoch keying
+
+
+class TestRunstateEpochKeying:
+    def test_path_is_epoch_scoped(self):
+        from imaginaire_tpu.resilience.runstate import runstate_path
+
+        assert runstate_path("/x/ck", 0, epoch=0) == \
+            "/x/ck.runstate.json"
+        assert runstate_path("/x/ck", 2, epoch=0) == \
+            "/x/ck.runstate.p2.json"
+        assert runstate_path("/x/ck", 0, epoch=1) == \
+            "/x/ck.runstate.e1.p0.json"
+        assert runstate_path("/x/ck", 2, epoch=3) == \
+            "/x/ck.runstate.e3.p2.json"
+
+    def test_master_dual_writes_at_nonzero_epoch(self, tmp_path,
+                                                 monkeypatch):
+        from imaginaire_tpu.resilience import runstate
+
+        monkeypatch.setattr(
+            "imaginaire_tpu.parallel.mesh.get_rank", lambda: 0)
+        cluster.set_membership_epoch(1)
+        try:
+            ck = str(tmp_path / "ck")
+            rs = runstate.build_runstate(2, 7, 3)
+            runstate.write_runstate(ck, rs)
+        finally:
+            cluster.set_membership_epoch(None)
+        # the epoch-keyed sidecar AND the legacy cluster-truth copy
+        assert os.path.exists(ck + ".runstate.e1.p0.json")
+        assert os.path.exists(ck + ".runstate.json")
+
+    def test_nonmaster_writes_only_epoch_key(self, tmp_path,
+                                             monkeypatch):
+        from imaginaire_tpu.resilience import runstate
+
+        monkeypatch.setattr(
+            "imaginaire_tpu.parallel.mesh.get_rank", lambda: 1)
+        cluster.set_membership_epoch(2)
+        try:
+            ck = str(tmp_path / "ck")
+            runstate.write_runstate(ck, runstate.build_runstate(0, 4, 1))
+        finally:
+            cluster.set_membership_epoch(None)
+        assert os.path.exists(ck + ".runstate.e2.p1.json")
+        assert not os.path.exists(ck + ".runstate.json")
+        assert not os.path.exists(ck + ".runstate.p1.json")
+
+    def test_remap_falls_back_to_legacy_master(self, tmp_path, caplog):
+        import logging as _logging
+
+        from imaginaire_tpu.resilience import runstate
+
+        ck = str(tmp_path / "ck")
+        # checkpoint written by the PRE-resize membership (epoch 0)
+        with open(ck + ".runstate.json", "w") as f:
+            json.dump(runstate.build_runstate(1, 6, 2), f)
+        cluster.set_membership_epoch(1)
+        try:
+            with caplog.at_level(_logging.INFO,
+                                 logger="imaginaire_tpu.resilience"
+                                        ".runstate"):
+                got = runstate.read_runstate(ck, process_index=1)
+        finally:
+            cluster.set_membership_epoch(None)
+        assert got is not None and got["iteration"] == 6
+        assert any("runstate remap" in r.message for r in caplog.records)
+
+    def test_own_epoch_sidecar_wins_no_remap(self, tmp_path, caplog):
+        import logging as _logging
+
+        from imaginaire_tpu.resilience import runstate
+
+        ck = str(tmp_path / "ck")
+        with open(ck + ".runstate.json", "w") as f:
+            json.dump(runstate.build_runstate(0, 2, 0), f)
+        with open(ck + ".runstate.e1.p1.json", "w") as f:
+            json.dump(runstate.build_runstate(1, 9, 4), f)
+        cluster.set_membership_epoch(1)
+        try:
+            with caplog.at_level(_logging.INFO,
+                                 logger="imaginaire_tpu.resilience"
+                                        ".runstate"):
+                got = runstate.read_runstate(ck, process_index=1)
+        finally:
+            cluster.set_membership_epoch(None)
+        assert got["iteration"] == 9 and got["batch_in_epoch"] == 4
+        assert not any("runstate remap" in r.message
+                       for r in caplog.records)
+
+    def test_integrity_knows_epoch_sidecars(self, tmp_path):
+        from imaginaire_tpu.resilience import integrity
+
+        assert integrity.runstate_index("ck.runstate.e2.p3.json") == 3
+        assert integrity.runstate_index("ck.runstate.p3.json") == 3
+        assert integrity.runstate_index("ck.runstate.json") is None
+        assert integrity.runstate_epoch("ck.runstate.e2.p3.json") == 2
+        assert integrity.runstate_epoch("ck.runstate.p3.json") == 0
+        assert integrity.runstate_epoch("ck.runstate.json") == 0
+        assert integrity.runstate_epoch("ck.partition.json") is None
+        # epoch-keyed sidecars from a larger world are orphans too
+        ck = str(tmp_path / "ck")
+        for name in (".runstate.json", ".runstate.e1.p1.json",
+                     ".runstate.e1.p4.json"):
+            with open(ck + name, "w") as f:
+                f.write("{}")
+        orphans = integrity.orphan_sidecars(ck, world_size=2)
+        assert [os.path.basename(p) for p in orphans] == \
+            ["ck.runstate.e1.p4.json"]
+
+
+# ------------------------------------------------------ harness verdict
+
+
+class TestHarnessExitMap:
+    def _mod(self):
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(__file__), "..",
+                            "scripts", "launch_local_pod.py")
+        spec = importlib.util.spec_from_file_location(
+            "launch_local_pod", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_parse_exit_map(self):
+        mod = self._mod()
+        assert mod.parse_exit_map("0:75,1:0,2:0") == {0: 75, 1: 0, 2: 0}
+        assert mod.parse_exit_map(None) == {}
+        assert mod.parse_exit_map("") == {}
+        with pytest.raises(ValueError):
+            mod.parse_exit_map("nonsense")
+
+    def test_expect_exit_map_flag_parses(self):
+        mod = self._mod()
+        args = mod.parse_args(["--num-processes", "2",
+                               "--expect-exit-map", "0:75,1:0",
+                               "--", "train.py"])
+        assert args.expect_exit_map == {0: 75, 1: 0}
+
+    def test_elastic_defaults_child_log_dir(self, tmp_path):
+        mod = self._mod()
+        args = mod.parse_args(["--elastic", "--logdir", str(tmp_path),
+                               "--relaunch", "--", "train.py"])
+        assert args.relaunch
+        assert args.child_log_dir == os.path.join(str(tmp_path),
+                                                  "pod-logs")

@@ -20,7 +20,7 @@ import numpy as np
 import pytest
 
 import __graft_entry__ as ge
-from imaginaire_tpu.config import Config
+from imaginaire_tpu.config import AttrDict, Config
 from imaginaire_tpu.parallel.mesh import (
     create_mesh,
     mesh_from_config,
@@ -462,3 +462,181 @@ class TestPerDeviceBytes:
     def test_host_arrays_count_global(self):
         assert per_device_tree_bytes(
             {"x": np.zeros((4,), np.float32)}) == 16
+
+
+class TestElasticRederivation:
+    """Elastic re-derivation (ISSUE 11): the same save -> re-fit ->
+    restore flow the in-process resize drives, on virtual devices. A
+    plan derived for the shrunken (and re-grown) world restores the
+    checkpointed state redistributed under its shardings, and the
+    training math stays on the never-resized trajectory."""
+
+    def _trainer_on(self, shape, batch, seed=0, logdir=None):
+        mesh = create_mesh(("data", "model"), shape,
+                           devices=np.array(
+                               jax.devices()[:int(np.prod(shape))]))
+        set_mesh(mesh)
+        trainer, cfg = _tiny_trainer(
+            mesh_shape={"data": int(shape[0]), "model": int(shape[1])})
+        if logdir is not None:
+            trainer.cfg.logdir = str(logdir)
+        trainer.init_state(jax.random.PRNGKey(seed), batch)
+        return trainer, mesh
+
+    @pytest.mark.slow
+    def test_shrink_grow_roundtrip_tracks_unresized_run(self, tmp_path):
+        """(4,1) -> (3,1) -> (4,1) with adam + EMA: one step per
+        topology, checkpointing through each resize, stays on the
+        never-resized 3-step trajectory (fp32 tolerance — the same
+        global batch reduces over a different device partition at world
+        3)."""
+        from imaginaire_tpu.parallel.mesh import fit_mesh_shape
+
+        batch = jax.tree_util.tree_map(
+            np.asarray, ge._tiny_batch(12, h=64, w=64))
+
+        # the never-resized reference: 3 steps on (4,1)
+        t_ref, mesh = self._trainer_on((4, 1), batch)
+        b = place_committed_batch(batch, mesh=mesh)
+        h_ref = []
+        for _ in range(3):
+            d = t_ref.dis_update(b)
+            g = t_ref.gen_update(b)
+            h_ref.append((float(d["total"]), float(g["total"])))
+
+        # the resized run: step on (4,1), save, re-fit to 3 devices
+        t_a, mesh_a = self._trainer_on((4, 1), batch, logdir=tmp_path)
+        b_a = place_committed_batch(batch, mesh=mesh_a)
+        h_rsz = []
+        d = t_a.dis_update(b_a)
+        g = t_a.gen_update(b_a)
+        h_rsz.append((float(d["total"]), float(g["total"])))
+        path_a = t_a.save_checkpoint(0, 1)
+
+        cfg41 = AttrDict(
+            {"parallel": {"mesh_shape": [4, 1],
+                          "axes": ["data", "model"]}})
+        axes, dims = fit_mesh_shape(cfg41, 3)
+        assert list(dims) == [3, 1]
+        t_b, mesh_b = self._trainer_on(tuple(dims), batch, seed=1,
+                                       logdir=tmp_path)
+        assert t_b.load_checkpoint(path_a, resume=True)
+        # the optimizer moments landed REDISTRIBUTED under the new plan
+        mu = jax.tree_util.tree_leaves(t_b.state["opt_G"])[1]
+        assert mu.sharding.mesh.shape["data"] == 3
+        b_b = place_committed_batch(batch, mesh=mesh_b)
+        d = t_b.dis_update(b_b)
+        g = t_b.gen_update(b_b)
+        h_rsz.append((float(d["total"]), float(g["total"])))
+        path_b = t_b.save_checkpoint(0, 2)
+
+        # grow back: re-fit to 4 devices returns the original shape
+        axes, dims = fit_mesh_shape(cfg41, 4)
+        assert list(dims) == [4, 1]
+        t_c, mesh_c = self._trainer_on((4, 1), batch, seed=2,
+                                       logdir=tmp_path)
+        assert t_c.load_checkpoint(path_b, resume=True)
+        mu = jax.tree_util.tree_leaves(t_c.state["opt_G"])[1]
+        assert mu.sharding.mesh.shape["data"] == 4
+        b_c = place_committed_batch(batch, mesh=mesh_c)
+        d = t_c.dis_update(b_c)
+        g = t_c.gen_update(b_c)
+        h_rsz.append((float(d["total"]), float(g["total"])))
+
+        np.testing.assert_allclose(np.asarray(h_rsz),
+                                   np.asarray(h_ref), rtol=5e-3)
+        for key in ("vars_G", "ema_G"):
+            ref = jax.device_get(t_ref.state[key])
+            rsz = jax.device_get(t_c.state[key])
+            for a, b2 in zip(jax.tree_util.tree_leaves(ref),
+                             jax.tree_util.tree_leaves(rsz)):
+                np.testing.assert_allclose(a, b2, atol=5e-3)
+
+    def test_model_axis_collapse_refit_restores(self, tmp_path, caplog):
+        """(2,2) save -> 2 surviving devices: fit_mesh_shape collapses
+        the model axis toward pure DP (warning loudly), and the
+        checkpoint restores redistributed under the (2,1) plan."""
+        import logging
+
+        from imaginaire_tpu.parallel.mesh import fit_mesh_shape
+
+        batch = jax.tree_util.tree_map(
+            np.asarray, ge._tiny_batch(2, h=64, w=64))
+        t_a, _ = self._trainer_on((2, 2), batch, logdir=tmp_path)
+        path = t_a.save_checkpoint(0, 1)
+
+        cfg22 = AttrDict(
+            {"parallel": {"mesh_shape": [2, 2],
+                          "axes": ["data", "model"]}})
+        with caplog.at_level(logging.WARNING):
+            axes, dims = fit_mesh_shape(cfg22, 2)
+        assert list(dims) == [2, 1]
+        assert any("model" in r.message for r in caplog.records)
+
+        t_b, _ = self._trainer_on(tuple(dims), batch, seed=1,
+                                  logdir=tmp_path)
+        assert t_b.load_checkpoint(path, resume=True)
+        a = jax.device_get(t_a.state["vars_G"]["params"])
+        b = jax.device_get(t_b.state["vars_G"]["params"])
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)):
+            np.testing.assert_array_equal(x, y)
+        mu = jax.tree_util.tree_leaves(t_b.state["opt_G"])[1]
+        assert mu.sharding.mesh.shape["data"] == 2
+        assert dict(mu.sharding.mesh.shape).get("model", 1) == 1
+
+    def test_elastic_rebind_restores_state_structure(self, tmp_path):
+        """The in-process resize restore must hand optax its
+        NamedTuples back: ``elastic_rebind`` drops the dead world's
+        state but keeps an abstract template, and the next
+        ``load_checkpoint`` restores INTO that structure — a plain
+        no-target restore returns nested dicts and the first
+        post-resize ``tx.update`` dies on ``state.mu``."""
+        batch = jax.tree_util.tree_map(
+            np.asarray, ge._tiny_batch(2, h=64, w=64))
+        t, _ = self._trainer_on((2, 1), batch, logdir=tmp_path)
+        structure = jax.tree_util.tree_structure(t.state)
+        t.save_checkpoint(0, 1)
+
+        t.elastic_rebind()
+        assert t.state is None
+        assert t._elastic_state_template is not None
+        assert t.load_checkpoint(resume=True)
+        assert jax.tree_util.tree_structure(t.state) == structure
+        assert t._elastic_state_template is None  # donor consumed
+
+    def test_min_shard_size_floor_across_worlds(self):
+        """Re-derivation constraints at a NEW world size: the
+        min_shard_size floor gates rule-axis (model) sharding, and the
+        ZeRO update axis — floorless by design — still demands exact
+        divisibility, so a leaf sharded over 4 hosts correctly
+        replicates over 3 when divisibility is lost."""
+        sizes4 = {"data": 4, "model": 1}
+        sizes3 = {"data": 3, "model": 1}
+        model4 = {"data": 1, "model": 4}
+        # rule axis: wide kernel shards, narrow one falls below floor
+        assert tuple(leaf_partition_spec(
+            "kernel", (16, 128), model4,
+            min_shard_size=64)) == (None, "model")
+        assert tuple(leaf_partition_spec(
+            "kernel", (16, 32), model4, min_shard_size=64)) == ()
+        # update axis has NO width floor: a bias far below the floor
+        # still shards (halving a bias is still free memory) ...
+        assert tuple(leaf_partition_spec(
+            "bias", (96,), sizes3, min_shard_size=64,
+            update_axis="data")) == ("data",)
+        # ... but exact divisibility re-applies at the new world:
+        # world-4-divisible, not world-3-divisible -> replicate
+        assert tuple(leaf_partition_spec(
+            "bias", (128,), sizes4, min_shard_size=8,
+            update_axis="data")) == ("data",)
+        assert tuple(leaf_partition_spec(
+            "bias", (128,), sizes3, min_shard_size=8,
+            update_axis="data")) == ()
+        # divisible at both worlds: stays sharded at both
+        assert tuple(leaf_partition_spec(
+            "bias", (96,), sizes4, min_shard_size=8,
+            update_axis="data")) == ("data",)
+        assert tuple(leaf_partition_spec(
+            "bias", (96,), sizes3, min_shard_size=8,
+            update_axis="data")) == ("data",)

@@ -223,6 +223,67 @@ class TestFallback:
             str(tmp_path))
         assert payload is None and path is None and fallbacks == 0
 
+    def test_infra_error_raises_without_quarantine(self, tmp_path,
+                                                   monkeypatch):
+        # an XlaRuntimeError (gloo context timeout, wedged collective
+        # layer — ISSUE 13) says nothing about the checkpoint's bytes:
+        # quarantining on it would condemn every candidate in a healthy
+        # logdir. It must propagate and leave the directory untouched.
+        ckpt_lib.save_checkpoint(str(tmp_path), _state(), 0, 1)
+
+        class XlaRuntimeError(Exception):
+            pass
+
+        def _boom(path, target=None, verify=True):
+            raise XlaRuntimeError("DEADLINE_EXCEEDED: gloo context")
+
+        monkeypatch.setattr(ckpt_lib, "load_checkpoint", _boom)
+        with pytest.raises(XlaRuntimeError):
+            ckpt_lib.load_latest_verified(str(tmp_path),
+                                          target=_state())
+        assert not any(".corrupt" in n for n in os.listdir(tmp_path))
+
+    def test_restore_suppresses_orbax_process_sync(self):
+        # elastic restores are asymmetric (a joiner restores while the
+        # survivors re-commit live state) — orbax's untimed end-of-
+        # restore all-device sync must be neutered for the duration and
+        # restored after
+        from orbax.checkpoint import checkpointer as ocp_checkpointer
+
+        orig = ocp_checkpointer.multihost.sync_global_processes
+        with ckpt_lib._no_restore_barrier():
+            patched = ocp_checkpointer.multihost.sync_global_processes
+            assert patched is not orig
+            patched("any_barrier_name", processes={0, 1})  # no-op
+        assert ocp_checkpointer.multihost.sync_global_processes is orig
+
+    def test_save_aligns_orbax_barrier_counters(self):
+        # orbax suffixes barrier keys with per-process save counters; an
+        # elastic joiner has a shorter save history than the survivors,
+        # so without re-alignment the counters diverge and the collective
+        # save dies with "sync_global_devices name mismatch"
+        from orbax.checkpoint.multihost import counters
+
+        # burn a few ticks to simulate a process with prior saves
+        for _ in range(3):
+            counters.tmp_directory_counter()
+        assert counters.tmp_directory_counter() != "0"
+        ckpt_lib._align_orbax_barrier_counters()
+        assert counters.tmp_directory_counter() == "0"
+        # uniqueness WITHIN a save sequence is preserved
+        assert counters.tmp_directory_counter() == "1"
+        if hasattr(counters, "async_save_counter"):
+            ckpt_lib._align_orbax_barrier_counters()
+            assert counters.async_save_counter() == "0"
+
+    def test_save_path_invokes_counter_alignment(self, tmp_path,
+                                                 monkeypatch):
+        calls = []
+        monkeypatch.setattr(ckpt_lib, "_align_orbax_barrier_counters",
+                            lambda: calls.append(1))
+        ckpt_lib.save_checkpoint(str(tmp_path), _state(1), 0, 1)
+        assert calls == [1]
+
 
 # ------------------------------------------------------------- retention
 
