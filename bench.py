@@ -27,6 +27,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -66,7 +67,32 @@ def _leg_summary(tm, xla_mark=None, trainer=None):
     if trainer is not None:
         out["precision"] = _precision_leg(trainer)
     out["resilience"] = _resilience_leg()
+    out.update(_pipeline_leg(tm))
     return out
+
+
+def _pipeline_leg(tm):
+    """{pipeline_depth, overlap_ratio, dispatch_gap_ms} for one bench
+    leg (ISSUE 14) — the LAST rollout's counters from the software
+    pipeline's instrument (parallel/pipeline.py; the sequential path
+    reports depth 0 from the same meter). All None for image-family
+    legs, which never emit the counters."""
+    latest = {}
+    try:
+        with tm._lock:
+            events = list(tm._events)
+        for ev in events:
+            if ev.get("kind") == "counter" and \
+                    str(ev.get("name", "")).startswith("pipeline/"):
+                latest[ev["name"]] = ev.get("value")
+    except Exception:  # noqa: BLE001 — bench accounting is best-effort
+        pass
+    depth = latest.get("pipeline/depth")
+    return {
+        "pipeline_depth": int(depth) if depth is not None else None,
+        "overlap_ratio": latest.get("pipeline/overlap_ratio"),
+        "dispatch_gap_ms": latest.get("pipeline/dispatch_gap_ms"),
+    }
 
 
 def _precision_leg(trainer):
@@ -267,7 +293,7 @@ def build_unit():
 
 
 def build_vid2vid(flow_teacher=True, hw=(512, 1024), rollout_scan=False,
-                  flow_cache=None):
+                  flow_cache=None, pipeline=None):
     """The shipped cityscapes vid2vid recipe (512x1024, bs2, interleaved
     per-frame D+G rollout with flow warp + multi-SPADE combine).
     ``hw`` below (512, 1024) is the measured-fallback size for the
@@ -280,6 +306,10 @@ def build_vid2vid(flow_teacher=True, hw=(512, 1024), rollout_scan=False,
                               "configs", "projects", "vid2vid", "cityscapes",
                               "bf16.yaml"))
     cfg.trainer.rollout_scan = rollout_scan
+    if pipeline is not None:
+        # software-pipelined dispatch A/B (ISSUE 14): e.g.
+        # {"enabled": False} for the sequential baseline leg
+        cfg.trainer.pipeline = dict(pipeline)
     if flow_cache is not None:
         # teacher-amortization A/B legs (run_teacher_ab): e.g.
         # {"enabled": True, "mode": "disk", "dir": ...}
@@ -438,6 +468,117 @@ def run_teacher_ab(width="zoo", hw=(256, 512), bs=2, seq_len=4, iters=4):
     return payload
 
 
+def run_pipeline_ab(width="unit", hw=(256, 512), bs=1, seq_len=4, iters=4):
+    """Software-pipelined dispatch A/B (ISSUE 14 acceptance record):
+    the same vid2vid recipe driven three ways — sequential per-frame
+    loop (trainer.pipeline disabled; the depth-0 meter still runs so
+    the before/after dispatch-gap table shares one instrument),
+    pipelined dispatch (depth 2, loop invariants hoisted), and the
+    demoted whole-rollout scan — recording every variant's frames/s
+    plus both dispatch-gap/overlap meters into VIDBENCH.json under
+    ``pipelined_ab``. ``--width unit`` runs the 64x64 unit-test recipe
+    (CPU-feasible smoke; on a single local device the rollout is
+    compute-bound, so parity is the expected result and the meters are
+    the signal); ``zoo`` the cityscapes recipe (run_vid2vid wires the
+    same A/B into the headline leg at the bench operating point, where
+    the tunneled dispatch latency is the cost being hidden)."""
+    import jax
+    import jax.numpy as jnp
+
+    tm = _bench_telemetry()
+    leg_knobs = {
+        "sequential": {"pipeline": {"enabled": False}},
+        "pipelined": {"pipeline": {"enabled": True, "depth": 2,
+                                   "overlap_collectives": True}},
+        "rollout_scan": {"pipeline": {"enabled": False},
+                         "rollout_scan": True},
+    }
+
+    def build(leg):
+        knobs = leg_knobs[leg]
+        if width == "unit":
+            from imaginaire_tpu.config import Config
+            from imaginaire_tpu.registry import resolve
+
+            cfg = Config(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "configs",
+                "unit_test", "vid2vid_street.yaml"))
+            cfg.trainer.perceptual_loss.layers = ["relu_1_1", "relu_2_1"]
+            cfg.trainer.perceptual_loss.weights = [0.5, 1.0]
+            cfg.dis.image.num_discriminators = 1
+            cfg.trainer.rollout_scan = bool(knobs.get("rollout_scan"))
+            cfg.trainer.pipeline = dict(knobs["pipeline"])
+            trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+            rng = np.random.RandomState(0)
+            data = {
+                "images": rng.rand(bs, seq_len, 64, 64, 3).astype(
+                    np.float32) * 2 - 1,
+                "label": (rng.rand(bs, seq_len, 64, 64, 12) > 0.9).astype(
+                    np.float32),
+            }
+            return trainer, data
+        trainer, label_ch = build_vid2vid(
+            True, hw, rollout_scan=bool(knobs.get("rollout_scan")),
+            pipeline=knobs["pipeline"])
+        return trainer, vid2vid_batch(bs, seq_len, label_ch,
+                                      h=hw[0], w=hw[1])
+
+    rates, meters = {}, {}
+    for leg in ("sequential", "pipelined", "rollout_scan"):
+        jax.clear_caches()
+        trainer, data = build(leg)
+        trainer.init_state(jax.random.PRNGKey(0), data)
+
+        def sync():
+            leaf = jax.tree_util.tree_leaves(
+                trainer.state["vars_G"]["params"])[0]
+            return float(jnp.sum(leaf))
+
+        for i in range(2):  # compile both per-frame programs + warm
+            batch = trainer.start_of_iteration(dict(data), i)
+            trainer.dis_update(batch)
+            trainer.gen_update(batch)
+        sync()
+        tm.reset_window()
+        t0 = time.time()
+        for i in range(iters):
+            batch = trainer.start_of_iteration(dict(data), i)
+            trainer.dis_update(batch)
+            trainer.gen_update(batch)
+            tm.step_complete(i, items=bs * seq_len)
+        sync()
+        rates[leg] = bs * seq_len * iters / (time.time() - t0)
+        meters[leg] = _pipeline_leg(tm)  # this leg's LAST rollout
+        trainer.state = None
+
+    speedup_pct = (rates["pipelined"] / rates["sequential"] - 1.0) * 100.0
+    payload = {"pipelined_ab": {
+        "width": width,
+        "platform": jax.devices()[0].platform,
+        "sequential_fps": round(rates["sequential"], 3),
+        "pipelined_fps": round(rates["pipelined"], 3),
+        "rollout_scan_fps": round(rates["rollout_scan"], 3),
+        "pipelined_vs_sequential_pct": round(speedup_pct, 2),
+        "winning_variant": max(rates, key=rates.get),
+        "sequential_dispatch_gap_ms":
+            meters["sequential"]["dispatch_gap_ms"],
+        "pipelined_dispatch_gap_ms":
+            meters["pipelined"]["dispatch_gap_ms"],
+        "sequential_overlap_ratio": meters["sequential"]["overlap_ratio"],
+        "pipelined_overlap_ratio": meters["pipelined"]["overlap_ratio"],
+        "pipeline_depth": meters["pipelined"]["pipeline_depth"],
+        "iters": iters,
+    }}
+    _merge_vidbench(payload)
+    print(json.dumps({
+        "metric": "vid2vid_pipelined_vs_sequential_speedup_pct",
+        "value": round(speedup_pct, 2),
+        "unit": "pct",
+        "vs_baseline": None,
+    }))
+    return payload
+
+
 def run_vid2vid(seq_len=4):
     """Steady-state frames/sec of the interleaved per-frame rollout.
 
@@ -469,7 +610,11 @@ def run_vid2vid(seq_len=4):
                 trainer.state = None
             trainer = data = None
             jax.clear_caches()
-            trainer, label_ch = build_vid2vid(flow_teacher, hw)
+            # sequential per-frame baseline first (pipeline disabled):
+            # the A/B reference the pipelined variant must beat, and the
+            # headline stays intact if the pipelined leg fails
+            trainer, label_ch = build_vid2vid(flow_teacher, hw,
+                                              pipeline={"enabled": False})
             xla_mark = _xla_mark()
             data = jax.device_put(jax.tree_util.tree_map(
                 np.asarray,
@@ -501,17 +646,50 @@ def run_vid2vid(seq_len=4):
             dt = time.time() - t0
             leg_telemetry = _leg_summary(tm, xla_mark, trainer=trainer)
             frames_per_sec = bs * seq_len * iters / dt
+            # software-pipelined dispatch A/B (ISSUE 14): same recipe,
+            # same programs, deferred completion polls. Measured second
+            # so a pipeline-side failure can't cost the baseline number.
+            pipelined_frames_per_sec = None
+            pipelined_telemetry = None
+            try:
+                trainer.state = None
+                trainer = None
+                jax.clear_caches()
+                tm.reset_window()
+                trainer, _ = build_vid2vid(
+                    flow_teacher, hw,
+                    pipeline={"enabled": True, "depth": 2,
+                              "overlap_collectives": True})
+                trainer.init_state(jax.random.PRNGKey(0), data)
+                for _ in range(2):
+                    trainer.dis_update(data)
+                    trainer.gen_update(data)
+                sync()
+                tm.reset_window()
+                t0 = time.time()
+                for i in range(iters):
+                    trainer.dis_update(data)
+                    trainer.gen_update(data)
+                    tm.step_complete(i, items=bs * seq_len)
+                sync()
+                pipelined_frames_per_sec = bs * seq_len * iters / (
+                    time.time() - t0)
+                pipelined_telemetry = _leg_summary(tm, trainer=trainer)
+            except Exception as e:
+                print(f"# pipelined leg failed: {e!r}", flush=True)
             # same recipe with the whole-rollout scan tail
             # (trainer.rollout_scan) for the head-to-head record;
-            # measured second so a scan-side failure can't cost the
-            # baseline number
+            # measured last so a scan-side failure can't cost the
+            # baseline number (PROFILE.md Round 5: the known loser,
+            # kept in the record)
             scan_frames_per_sec = None
             try:
                 trainer.state = None
                 trainer = None
                 jax.clear_caches()
                 trainer, _ = build_vid2vid(flow_teacher, hw,
-                                           rollout_scan=True)
+                                           rollout_scan=True,
+                                           pipeline={"enabled": False})
                 trainer.init_state(jax.random.PRNGKey(0), data)
                 for _ in range(2):
                     trainer.dis_update(data)
@@ -536,6 +714,9 @@ def run_vid2vid(seq_len=4):
                 metric += "_noteacher"
             best = frames_per_sec
             winning_variant = "per_frame_loop"
+            if pipelined_frames_per_sec and pipelined_frames_per_sec > best:
+                best = pipelined_frames_per_sec
+                winning_variant = "pipelined"
             if scan_frames_per_sec and scan_frames_per_sec > best:
                 best = scan_frames_per_sec
                 winning_variant = "rollout_scan"
@@ -551,6 +732,10 @@ def run_vid2vid(seq_len=4):
                                flow_teacher=flow_teacher,
                                winning_variant=winning_variant,
                                per_frame_loop_fps=round(frames_per_sec, 3),
+                               pipelined_fps=(
+                                   round(pipelined_frames_per_sec, 3)
+                                   if pipelined_frames_per_sec else None),
+                               pipelined_telemetry=pipelined_telemetry,
                                rollout_scan_fps=(
                                    round(scan_frames_per_sec, 3)
                                    if scan_frames_per_sec else None),
@@ -1127,6 +1312,225 @@ def run(trainer, label_ch, batch_sizes, metric):
     raise SystemExit(f"bench failed at all batch sizes: {last_error}")
 
 
+def _pod_spade_cfg():
+    """Tiny spade recipe for the pod-scaling legs: the pod harness runs
+    on localhost CPUs (one virtual device per process), so the workload
+    must be dryrun-sized — the leg measures multi-process scaling of the
+    REAL distributed stack (gloo collectives, global batch assembly),
+    not chip throughput."""
+    from imaginaire_tpu.config import Config
+
+    cfg = Config()
+    cfg.trainer.type = "imaginaire_tpu.trainers.spade"
+    cfg.trainer.gan_mode = "hinge"
+    cfg.trainer.loss_weight = {"gan": 1.0, "feature_matching": 10.0,
+                               "kl": 0.05, "perceptual": 10.0}
+    cfg.trainer.perceptual_loss = {
+        "mode": "vgg19", "layers": ["relu_1_1", "relu_2_1"],
+        "weights": [0.5, 1.0], "allow_random_init": True}
+    cfg.gen = {
+        "type": "imaginaire_tpu.models.generators.spade",
+        "style_dims": 16, "num_filters": 4, "kernel_size": 3,
+        "weight_norm_type": "spectral",
+        "global_adaptive_norm_type": "instance",
+        "activation_norm_params": {"num_filters": 4, "kernel_size": 3,
+                                   "activation_norm_type": "instance",
+                                   "weight_norm_type": "none",
+                                   "separate_projection": False},
+        "style_enc": {"num_filters": 4, "kernel_size": 3},
+    }
+    cfg.dis = {
+        "type": "imaginaire_tpu.models.discriminators.spade",
+        "num_filters": 4, "max_num_filters": 16, "num_discriminators": 2,
+        "num_layers": 2, "weight_norm_type": "spectral",
+    }
+    cfg.data = {
+        "name": "podbench", "type": "imaginaire_tpu.data.paired_images",
+        "input_types": [
+            {"images": {"num_channels": 3, "normalize": True}},
+            {"seg_maps": {"num_channels": 4, "is_mask": True,
+                          "use_dont_care": True,
+                          "interpolator": "NEAREST"}},
+        ],
+        "input_image": ["images"],
+        "input_labels": ["seg_maps"],
+        "train": {"batch_size": 1,
+                  "augmentations": {"random_crop_h_w": "256, 256"}},
+    }
+    cfg.gen_opt.lr = 1e-4
+    cfg.dis_opt.lr = 4e-4
+    return cfg
+
+
+def run_pod_child(model, iters=4, warmup=2):
+    """One pod process of a pod-scaling leg (``--pod-child``, spawned by
+    ``launch_local_pod.py --bench``): join the coordination service,
+    build the dryrun-sized workload on the pod-wide 'data' mesh, run the
+    real sharded train step, and have rank 0 print ONE JSON row the
+    harness folds into its leg-summary JSON."""
+    from imaginaire_tpu.parallel import mesh as pmesh
+
+    # must run before the backend initializes — it consumes the
+    # harness's IMAGINAIRE_DIST_* contract
+    pmesh.maybe_init_distributed_from_env()
+    import jax
+    import jax.numpy as jnp
+
+    from imaginaire_tpu.parallel.mesh import create_mesh, set_mesh
+    from imaginaire_tpu.parallel.sharding import place_committed_batch
+    from imaginaire_tpu.registry import resolve
+
+    mesh = create_mesh(("data",))
+    set_mesh(mesh)
+    n_dev = jax.device_count()
+    local_bs = jax.local_device_count()
+    rng = np.random.RandomState(jax.process_index())
+    seq_len = 1
+    if model == "vid2vid":
+        from imaginaire_tpu.config import Config
+
+        cfg = Config(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "configs",
+            "unit_test", "vid2vid_street.yaml"))
+        cfg.trainer.perceptual_loss.layers = ["relu_1_1", "relu_2_1"]
+        cfg.trainer.perceptual_loss.weights = [0.5, 1.0]
+        cfg.dis.image.num_discriminators = 1
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        seq_len = 3
+        h = w = 64
+        lab = (rng.rand(local_bs, seq_len, h, w, 12) > 0.9)
+        local = {
+            "images": rng.rand(local_bs, seq_len, h, w, 3).astype(
+                np.float32) * 2 - 1,
+            "label": lab.astype(np.float32),
+        }
+        unit = "frames/sec"
+    else:
+        cfg = _pod_spade_cfg()
+        trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+        h = w = 256  # the spade up-ladder's minimum generation size
+        lab = np.zeros((local_bs, h, w, 5), np.float32)
+        idx = rng.randint(0, 5, (local_bs, h, w))
+        np.put_along_axis(lab, idx[..., None], 1.0, axis=-1)
+        local = {
+            "images": rng.rand(local_bs, h, w, 3).astype(np.float32) * 2 - 1,
+            "label": lab,
+        }
+        unit = "imgs/sec"
+    with mesh:
+        # delegates to place_process_local_batch when multi-process:
+        # each process contributes its local rows to the global batch
+        data = place_committed_batch(local, mesh=mesh)
+        trainer.init_state(jax.random.PRNGKey(0), data)
+
+        def sync():
+            leaf = jax.tree_util.tree_leaves(
+                trainer.state["vars_G"]["params"])[0]
+            return float(jnp.sum(leaf))
+
+        for _ in range(warmup):
+            trainer.dis_update(data)
+            trainer.gen_update(data)
+        sync()
+        t0 = time.time()
+        for _ in range(iters):
+            trainer.dis_update(data)
+            trainer.gen_update(data)
+        sync()
+        dt = time.time() - t0
+    items = n_dev * seq_len * iters
+    if jax.process_index() == 0:
+        print(json.dumps({
+            "model": model,
+            "value": round(items / dt, 3),
+            "unit": unit,
+            "process_count": jax.process_count(),
+            "device_count": n_dev,
+            "iters": iters,
+            "step_ms": round(dt * 1e3 / iters, 2),
+        }), flush=True)
+
+
+def run_pod_scaling(host_counts=(1, 2, 3), timeout=900.0,
+                    models=("spade", "vid2vid")):
+    """First real multi-host throughput rows (ISSUE 14): imgs/s (spade)
+    and frames/s (vid2vid) vs host count, via the pod harness's clean
+    ``--bench`` mode. Each leg spawns N localhost processes with one
+    virtual CPU device each — real coordination service, real gloo
+    collectives, real global-batch assembly — and records the harness's
+    leg-summary JSON. Rows print as JSON lines (-> BENCH tail) and the
+    full record lands in PODBENCH.json. Best-effort per leg: a wedged
+    pod times out (the harness kills it) and the remaining legs still
+    run."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    harness = os.path.join(here, "scripts", "launch_local_pod.py")
+    book = {"host_counts": list(host_counts), "legs": []}
+    # partial reruns (models subset) keep the other models' rows: merge
+    # into the existing book rather than clobbering it
+    pod_path = os.path.join(here, "PODBENCH.json")
+    if os.path.exists(pod_path):
+        try:
+            with open(pod_path) as f:
+                prior = json.load(f)
+            book["legs"] = [leg for leg in prior.get("legs", [])
+                            if leg.get("model") not in models]
+        except (ValueError, OSError):
+            pass
+    for model in models:
+        for n in host_counts:
+            cmd = [sys.executable, harness, "--bench",
+                   "--num-processes", str(n), "--timeout", str(timeout),
+                   "--", "bench.py", "--pod-child", model]
+            try:
+                res = subprocess.run(
+                    cmd, cwd=here, capture_output=True, text=True,
+                    timeout=timeout + 120)
+                summary = None
+                for line in reversed(res.stdout.splitlines()):
+                    if line.lstrip().startswith("{"):
+                        try:
+                            obj = json.loads(line)
+                        except ValueError:
+                            continue
+                        if "pod_bench" in obj:
+                            summary = obj["pod_bench"]
+                            break
+                if summary is None:
+                    raise RuntimeError(
+                        f"no pod_bench summary (rc={res.returncode}, "
+                        f"tail={res.stdout[-300:]!r})")
+                rows = summary.get("rows") or []
+                rate = rows[0].get("value") if rows else None
+                unit = rows[0].get("unit") if rows else None
+                leg = {"model": model, "process_count": n,
+                       "exit_codes": summary.get("exit_codes"),
+                       "wall_s": summary.get("wall_s"),
+                       "value": rate, "unit": unit,
+                       "rows": rows}
+                book["legs"].append(leg)
+                print(json.dumps({
+                    "metric": f"pod_scaling_{model}_"
+                              f"{'frames' if model == 'vid2vid' else 'imgs'}"
+                              "_per_sec",
+                    "value": rate,
+                    "unit": unit,
+                    "vs_baseline": None,
+                    "process_count": n,
+                    "exit_codes": summary.get("exit_codes"),
+                }), flush=True)
+            except Exception as e:  # noqa: BLE001 — one leg, not the bench
+                print(f"# pod-scaling leg {model} x{n} failed: {e!r}",
+                      flush=True)
+                book["legs"].append({"model": model, "process_count": n,
+                                     "error": repr(e)})
+    book["legs"].sort(key=lambda leg: (leg.get("model", ""),
+                                       leg.get("process_count", 0)))
+    with open(pod_path, "w") as f:
+        json.dump(book, f, indent=1)
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--width", choices=("zoo", "unit"), default="zoo",
@@ -1157,7 +1561,33 @@ def main():
                              "-> VIDBENCH.json teacher_cache_speedup_pct; "
                              "--width unit runs the CPU-feasible 64x64 "
                              "smoke, zoo the cityscapes recipe")
+    parser.add_argument("--pipeline-ab", action="store_true",
+                        help="vid2vid software-pipelined dispatch A/B "
+                             "only (sequential vs pipelined vs "
+                             "rollout_scan) -> VIDBENCH.json "
+                             "pipelined_ab; --width unit runs the "
+                             "CPU-feasible 64x64 smoke, zoo the "
+                             "cityscapes recipe")
+    parser.add_argument("--pod-scaling", action="store_true",
+                        help="run ONLY the pod-scaling legs (ISSUE 14): "
+                             "imgs/s + frames/s at 1/2/3 localhost pod "
+                             "processes via launch_local_pod.py --bench "
+                             "-> PODBENCH.json")
+    parser.add_argument("--pod-child", default=None,
+                        choices=("spade", "vid2vid"),
+                        help="internal: run as one pod-scaling child "
+                             "process (spawned by launch_local_pod.py "
+                             "--bench; expects IMAGINAIRE_DIST_* env)")
     args = parser.parse_args()
+    if args.pod_child:
+        run_pod_child(args.pod_child)
+        return
+    if args.pod_scaling:
+        run_pod_scaling()
+        return
+    if args.pipeline_ab:
+        run_pipeline_ab(width=args.width if args.width == "unit" else "zoo")
+        return
     if args.teacher_ab:
         run_teacher_ab(width=args.width if args.width == "unit" else "zoo",
                        hw=(256, 512))
@@ -1177,6 +1607,15 @@ def main():
         run_family(args.model)
         return
     if args.width == "zoo":
+        # pod-scaling rows FIRST (ISSUE 14: the first real multi-host
+        # throughput numbers in BENCH) so the headline metric stays the
+        # LAST JSON line — the tracked time series must not change its
+        # anchor. Best-effort: the localhost pod legs run on CPU and a
+        # failure must never cost the chip headline.
+        try:
+            run_pod_scaling()
+        except Exception as e:  # noqa: BLE001
+            print(f"# pod-scaling legs failed: {e!r}", flush=True)
         trainer, label_ch = build_zoo()
         # nf=128 is ~4x the unit-width FLOPs; sweep down on OOM
         run(trainer, label_ch, (16, 8, 4, 2, 1),

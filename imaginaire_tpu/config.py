@@ -170,6 +170,14 @@ def default_config():
             # off, since jax_debug_nans re-runs ops against buffers
             # donation already invalidated
             donate_step_buffers=True,
+            # software-pipelined rollout dispatch (parallel/pipeline.py,
+            # ISSUE 14): defer the health monitor's one-behind finite
+            # polls by `depth` frames so the host issues frame t+1 while
+            # frame t's programs and gradient all-reduce are in flight.
+            # Bit-identical to the sequential loop; depth=0 or
+            # enabled=False restores it exactly.
+            pipeline=AttrDict(enabled=True, depth=2,
+                              overlap_collectives=True),
         ),
         gen=AttrDict(type="imaginaire_tpu.models.generators.dummy"),
         dis=AttrDict(type="imaginaire_tpu.models.discriminators.dummy"),

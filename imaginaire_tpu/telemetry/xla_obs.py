@@ -27,8 +27,10 @@ subsystems, all reporting through the existing ``Telemetry`` sinks:
   under ``xla_obs.strict_recompile`` a ``RecompileError`` raises.
   Legitimate re-specialization stays silent: shape-polymorphic labels
   (vid2vid's growing-sequence rollout) register with
-  ``allow_shape_growth`` and dtype/sharding-stable shape changes don't
-  count; deliberate re-jits (fs_vid2vid finetune swaps the optimizer)
+  ``allow_shape_growth`` and dtype/sharding-stable shape changes —
+  including leaves APPEARING as the conditioning ring buffers fill over
+  the first frames — don't count; deliberate re-jits (fs_vid2vid
+  finetune swaps the optimizer)
   call ``retrace(reason)`` or appear in
   ``xla_obs.expected_recompiles``.
 - **HBM accounting + OOM forensics** — per-device ``memory_stats()``
@@ -255,6 +257,14 @@ def fingerprint_diff(old, new):
     shape_only = (not added and not removed and all(
         old[p][0] == new[p][0] and old[p][2] == new[p][2]
         for p in changed))
+    # growth_only: leaves APPEAR (none removed, dtype/sharding of the
+    # survivors stable) — the ring-buffer warm-up shape of growth, where
+    # vid2vid's conditioning stacks (past_stacks, prev_images) fill over
+    # the first frames. Same legitimacy as pure shape growth; gated by
+    # the same per-label allow_shape_growth opt-in.
+    growth_only = (bool(added) and not removed and all(
+        old[p][0] == new[p][0] and old[p][2] == new[p][2]
+        for p in changed))
     settle_only = (not added and not removed and bool(changed) and all(
         old[p][0] == new[p][0] and old[p][1] == new[p][1]
         and old[p][2] in ("host", "single")
@@ -262,6 +272,7 @@ def fingerprint_diff(old, new):
         for p in changed))
     return {"changed": changed, "added": added, "removed": removed,
             "shape_only": bool(changed) and shape_only,
+            "growth_only": growth_only,
             "sharding_settle_only": settle_only}
 
 
@@ -602,7 +613,8 @@ class CompiledProgram:
                     # device arrays after step 1 — every label makes
                     # this transition exactly once
                     reason = "sharding_commit"
-                elif self._allow_shape_growth and diff["shape_only"]:
+                elif self._allow_shape_growth and (
+                        diff["shape_only"] or diff["growth_only"]):
                     reason = "shape_growth"
         elif self._pending_reason is not None:
             # post-retrace: the table is empty by design, but the

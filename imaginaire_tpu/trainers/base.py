@@ -147,6 +147,12 @@ class BaseTrainer:
         # donated buffers (see train.py)
         self._donate = ((0,) if cfg_get(tcfg, "donate_step_buffers", True)
                         else ())
+        # Software-pipelined rollout dispatch (parallel/pipeline.py,
+        # ISSUE 14): resolved here so every trainer shares one knob
+        # group; only the video trainers' per-frame rollout consumes it.
+        from imaginaire_tpu.parallel.pipeline import pipeline_settings
+
+        self.pipeline_cfg = pipeline_settings(cfg)
         # step programs dispatch through the compile ledger
         # (telemetry/xla_obs.py): the same compile that runs the step
         # records memory_analysis/cost_analysis and arms the recompile

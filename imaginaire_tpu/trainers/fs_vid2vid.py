@@ -159,7 +159,12 @@ class Trainer(Vid2VidTrainer):
         # the step programs closed over the old optimizer: drop the
         # cached executables and re-trace. This is the one legitimate
         # re-jit in the codebase — the ledger records it as expected
-        # (allowlisted) so the recompile tripwire stays silent.
+        # (allowlisted) so the recompile tripwire stays silent. Any
+        # deferred pipeline observations must land first — they hold
+        # outputs of the about-to-be-dropped executables (gen_update
+        # drains at rollout end, so this is a no-op outside mid-rollout
+        # callers; see parallel/pipeline.py).
+        self._rollout_pipeline.drain()
         self._jit_vid_dis.retrace("fs_vid2vid finetune re-jit")
         self._jit_vid_gen.retrace("fs_vid2vid finetune re-jit")
 

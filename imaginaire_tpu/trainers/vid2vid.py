@@ -18,6 +18,7 @@ no dynamic shapes inside any step.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Dict
 
@@ -37,6 +38,7 @@ from imaginaire_tpu.losses import (
 from imaginaire_tpu.losses.flow import masked_l1_loss
 from imaginaire_tpu.model_utils.fs_vid2vid import concat_frames, skip_stride_span
 from imaginaire_tpu.optim import init_optimizer_state
+from imaginaire_tpu.parallel.pipeline import RolloutPipeline, hoist_invariants
 from imaginaire_tpu.trainers.base import MUTABLE, BaseTrainer
 from imaginaire_tpu.utils.misc import numeric_only, to_device
 from imaginaire_tpu.utils.model_average import ema_init, ema_update
@@ -77,9 +79,31 @@ class Trainer(BaseTrainer):
         # trainer.rollout_scan; see gen_update/_rollout_scan_tail.
         self.rollout_scan = bool(cfg_get(cfg.trainer, "rollout_scan",
                                          False))
+        if self.rollout_scan:
+            # Demoted knob (ISSUE 14 / PROFILE.md Round 5): the whole-rollout
+            # scan measured ~19% SLOWER than the per-frame path (5.93 vs
+            # 7.28 frames/s) because one fused program forfeits the D/G
+            # async-dispatch overlap. Kept opt-in for the program-count
+            # story; warn once so nobody re-discovers the regression.
+            logging.warning(
+                "trainer.rollout_scan is a measured regression on the "
+                "per-frame path (5.93 vs 7.28 frames/s, see PROFILE.md "
+                "Round 5); prefer trainer.pipeline for rollout overlap")
+            telemetry.get().meta(
+                "rollout_scan_enabled",
+                verdict="PROFILE.md Round 5: ~19% slower than per-frame",
+                per_frame_fps=7.28, rollout_scan_fps=5.93)
         self._jit_rollout_tail = xla_obs.compiled_program(
             "rollout_tail", self._rollout_tail_fn,
             donate_argnums=self._donate, allow_shape_growth=True)
+        # Software-pipelined rollout dispatch (parallel/pipeline.py,
+        # ISSUE 14): one persistent scheduler per trainer, reset at each
+        # rollout. The sequential path runs the same instrument at
+        # depth=0, so the dispatch-gap/overlap meters are always live.
+        self._rollout_pipeline = RolloutPipeline(
+            depth=self.pipeline_cfg["depth"],
+            overlap_collectives=self.pipeline_cfg["overlap_collectives"])
+        self._seq_pipeline = RolloutPipeline(depth=0)
 
     # ---------------------------------------------------------------- loss
 
@@ -665,6 +689,23 @@ class Trainer(BaseTrainer):
         self._scan_key_verdict = (cache_key, not extra)
         return not extra
 
+    def _pipeline_eligible(self, data, seq_len):
+        """The software-pipelined dispatch (parallel/pipeline.py) defers
+        the monitor's one-behind finite polls by ``depth`` frames. That is
+        bit-identical to the sequential loop — same programs, same inputs,
+        same observation order — except for three cases it must refuse:
+        per-frame host hooks (wc-vid2vid reads back each generated frame,
+        so deferral would feed its renderer stale data), the ``rollback``
+        non-finite policy (its per-observation state snapshots must be
+        taken before later frames mutate the state), and overridden
+        ``_frame_override`` (same readback coupling)."""
+        cls = type(self)
+        return (self.pipeline_cfg["enabled"]
+                and self._rollout_pipeline.depth > 0
+                and cls._frame_override is Trainer._frame_override
+                and cls._after_gen_frame is Trainer._after_gen_frame
+                and getattr(self.diag, "on_nonfinite", "halt") != "rollback")
+
     def gen_update(self, data):
         """Interleaved per-frame D/G rollout (ref: vid2vid.py:238-288).
 
@@ -694,7 +735,25 @@ class Trainer(BaseTrainer):
         t_steady = max(self.num_frames_G - 1,
                        max_prev if self.num_temporal_scales > 0 else 0, 1)
         use_scan = self._scan_eligible(data, seq_len) and seq_len > t_steady
+        use_pipeline = self._pipeline_eligible(data, seq_len)
         head_len = t_steady if use_scan else seq_len
+        # both paths run the same dispatch-gap/overlap instrument; the
+        # sequential loop at depth=0 keeps its inline observes, so the
+        # meters measure the old behaviour unchanged
+        pipe = self._rollout_pipeline if use_pipeline else self._seq_pipeline
+        pipe.begin()
+        tm = telemetry.get()
+        if use_pipeline and pipe.overlap_collectives:
+            # ISSUE-14 satellite: loop-invariant per-frame operands
+            # (fs-vid2vid's reference window) gather ONCE per rollout
+            # instead of once per frame program — the gather overlaps
+            # frame 0's issue window and the per-frame collective bytes
+            # drop out of the graph-audit counters
+            data, hoisted = hoist_invariants(
+                data, self._rollout_scan_constants(data))
+            if hoisted:
+                tm.counter("pipeline/hoisted_bytes", hoisted,
+                           step=self.current_iteration)
         prev_labels = prev_images = None
         past_real = past_fake = None
         t0 = time.time() if self.speed_benchmark else None
@@ -710,30 +769,69 @@ class Trainer(BaseTrainer):
                 # boundary
                 data_jit = {k: v for k, v in data_t.items()
                             if not k.startswith("_")}
-                with telemetry.span("dis_step",
-                                    step=self.current_iteration):
-                    self.state, d_losses, d_health = self._jit_vid_dis(
-                        self.state, data_jit)
-                # per-frame health hooks: each frame's D and G update
-                # reports its own summary/finite flag (the monitor's
-                # cadence runs on the per-frame step counters)
-                self.diag.observe(self, "D", d_losses, d_health,
-                                  data_jit, self.current_iteration)
-                self.state, g_losses, fake, g_health = self._jit_vid_gen(
-                    self.state, data_jit)
-                self.diag.observe(self, "G", g_losses, g_health,
-                                  data_jit, self.current_iteration)
+                if use_pipeline:
+                    # pipelined: issue D_t/G_t back-to-back and DEFER the
+                    # monitor's finite polls by `depth` frames — the host
+                    # runs ahead slicing/dispatching while frame t's
+                    # programs and their gradient all-reduce are in
+                    # flight. Observation ORDER is unchanged; the DAG
+                    # marks prove the donated state handle threads
+                    # legally (G_{t-1} returned before D_t consumes it).
+                    with pipe.frame(t, tm, self.current_iteration):
+                        pipe.mark("data", t)
+                        with telemetry.span("dis_step",
+                                            step=self.current_iteration):
+                            self.state, d_losses, d_health = \
+                                self._jit_vid_dis(self.state, data_jit)
+                        pipe.mark("D", t)
+                        self.state, g_losses, fake, g_health = \
+                            self._jit_vid_gen(self.state, data_jit)
+                        pipe.mark("G", t)
+                        pipe.mark("grads", t)
+                    pipe.defer(lambda dl=d_losses, dh=d_health,
+                               gl=g_losses, gh=g_health, dj=data_jit,
+                               it=self.current_iteration: (
+                        self.diag.observe(self, "D", dl, dh, dj, it),
+                        self.diag.observe(self, "G", gl, gh, dj, it)))
+                else:
+                    with pipe.frame(t, tm, self.current_iteration):
+                        pipe.mark("data", t)
+                        with telemetry.span("dis_step",
+                                            step=self.current_iteration):
+                            self.state, d_losses, d_health = \
+                                self._jit_vid_dis(self.state, data_jit)
+                        pipe.mark("D", t)
+                    # per-frame health hooks: each frame's D and G update
+                    # reports its own summary/finite flag (the monitor's
+                    # cadence runs on the per-frame step counters). The
+                    # one-behind poll inside observe is what the frame
+                    # windows exclude — it lands in the dispatch gap.
+                    self.diag.observe(self, "D", d_losses, d_health,
+                                      data_jit, self.current_iteration)
+                    with pipe.frame(t, tm, self.current_iteration):
+                        self.state, g_losses, fake, g_health = \
+                            self._jit_vid_gen(self.state, data_jit)
+                        pipe.mark("G", t)
+                        pipe.mark("grads", t)
+                    self.diag.observe(self, "G", g_losses, g_health,
+                                      data_jit, self.current_iteration)
                 d_hist.append(d_losses)
                 g_hist.append(g_losses)
                 if self.num_temporal_scales > 0:
                     past_real = concat_frames(past_real, data_t["image"],
                                               max_prev)
                     past_fake = concat_frames(past_fake, fake, max_prev)
+            else:
+                pipe.override(t)
             self._after_gen_frame(data_t, fake)
             prev_labels = concat_frames(prev_labels, data_t["label"],
                                         self.num_frames_G - 1)
             prev_images = concat_frames(prev_images, fake,
                                         self.num_frames_G - 1)
+        # drain every deferred observation before anything else consumes
+        # the state: the monitor leaves this rollout in exactly the state
+        # the sequential loop would (one pending entry, same order)
+        pipe.finish(tm, step=self.current_iteration)
         tail_counts = 0
         if use_scan:
             # constants every frame of the tail shares (few-shot refs)

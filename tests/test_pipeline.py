@@ -1,0 +1,297 @@
+"""ISSUE-14 software-pipelined rollout dispatch (parallel/pipeline.py):
+FrameDAG ordering + donation-safety units, deferred-completion depth
+semantics, dispatch-gap/overlap meters, loop-invariant hoisting on the
+virtual mesh, trainer eligibility gates, and (slow) pipelined-vs-
+sequential bit parity over every state leaf with a zero-recompile
+assert through the compile ledger."""
+
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from imaginaire_tpu.config import AttrDict, Config
+from imaginaire_tpu.parallel.pipeline import (
+    STAGES,
+    FrameDAG,
+    PipelineOrderError,
+    RolloutPipeline,
+    hoist_invariants,
+    pipeline_settings,
+)
+from imaginaire_tpu.registry import resolve
+
+CFG = os.path.join(os.path.dirname(__file__), "..", "configs", "unit_test",
+                   "vid2vid_street.yaml")
+
+
+class TestFrameDAG:
+    def test_legal_issue_order(self):
+        dag = FrameDAG()
+        for t in range(3):
+            for stage in STAGES:
+                dag.mark(stage, t)
+        assert dag.frames == 3
+        assert dag.done("grads", 2)
+        # order() replays the marks as the canonical topological order
+        assert dag.order() == [(s, t) for t in range(3) for s in STAGES]
+
+    def test_deps_drop_preroll_frames(self):
+        dag = FrameDAG()
+        # frame 0 has no G_{-1} to wait on
+        assert dag.deps("data", 0) == ()
+        assert dag.deps("D", 0) == (("data", 0),)
+        assert dag.deps("D", 1) == (("data", 1), ("G", 0))
+        with pytest.raises(KeyError):
+            dag.deps("warp", 0)
+
+    def test_out_of_order_within_frame_raises(self):
+        dag = FrameDAG()
+        dag.mark("data", 0)
+        with pytest.raises(PipelineOrderError):
+            dag.mark("G", 0)  # D_0 never issued
+
+    def test_donated_state_edge_across_frames(self):
+        """D_t consumes the state handle G_{t-1} returns, and data_{t+1}
+        consumes G_t's ring-buffer output: issuing either before G_t is a
+        donation-safety violation and must raise, not silently reorder."""
+        dag = FrameDAG()
+        dag.mark("data", 0)
+        dag.mark("D", 0)
+        with pytest.raises(PipelineOrderError, match="donated state"):
+            dag.mark("data", 1)  # G_0 hasn't produced the ring buffers
+
+    def test_override_satisfies_downstream(self):
+        """A _frame_override frame (wc-vid2vid) supplies frame t's output
+        outside the DAG; satisfy() must unblock frame t+1."""
+        dag = FrameDAG()
+        dag.satisfy(0)
+        dag.mark("data", 1)
+        dag.mark("D", 1)
+        assert dag.frames == 2
+
+
+class TestRolloutPipeline:
+    def test_depth_zero_drains_inline(self):
+        pipe = RolloutPipeline(depth=0).begin()
+        calls = []
+        pipe.defer(lambda: calls.append(1))
+        assert calls == [1]
+
+    def test_depth_bounds_outstanding_records_fifo(self):
+        pipe = RolloutPipeline(depth=2).begin()
+        calls = []
+        for i in range(3):
+            pipe.defer(lambda i=i: calls.append(i))
+        # the third append drains only the OLDEST record
+        assert calls == [0]
+        pipe.drain()
+        assert calls == [0, 1, 2]
+
+    def test_finish_drains_everything(self):
+        pipe = RolloutPipeline(depth=4).begin()
+        calls = []
+        pipe.defer(lambda: calls.append("a"))
+        pipe.defer(lambda: calls.append("b"))
+        summary = pipe.finish()
+        assert calls == ["a", "b"]
+        assert summary["depth"] == 4
+
+    def test_begin_resets_meters_between_rollouts(self):
+        pipe = RolloutPipeline(depth=1).begin()
+        with pipe.frame(0):
+            pipe.mark("data", 0)
+        pipe.finish()
+        pipe.begin()
+        assert pipe.summary()["frames"] == 0
+
+    def test_meters_dispatch_gap_and_overlap(self):
+        """Two frame windows with a deliberate host stall between them:
+        the stall lands in the dispatch gap, the overlap ratio drops
+        below 1, and the frame count comes from the DAG (not the window
+        count, which differs on the two-window sequential path)."""
+        pipe = RolloutPipeline(depth=2).begin()
+        for t in range(2):
+            with pipe.frame(t):
+                for stage in STAGES:
+                    pipe.mark(stage, t)
+                time.sleep(0.01)  # issue work
+            time.sleep(0.02)  # host stall outside the window -> gap
+        s = pipe.finish()
+        assert s["frames"] == 2
+        assert s["dispatch_gap_ms"] > 1.0
+        assert s["issue_ms"] > 1.0
+        assert 0.0 <= s["overlap_ratio"] < 1.0
+
+    def test_negative_depth_clamps(self):
+        assert RolloutPipeline(depth=-3).depth == 0
+
+
+class TestPipelineSettings:
+    def test_defaults(self):
+        s = pipeline_settings(AttrDict())
+        assert s == {"enabled": True, "depth": 2,
+                     "overlap_collectives": True}
+
+    def test_config_group_round_trip(self):
+        cfg = Config(CFG)
+        cfg.trainer.pipeline = AttrDict(
+            enabled=False, depth=5, overlap_collectives=False)
+        s = pipeline_settings(cfg)
+        assert s == {"enabled": False, "depth": 5,
+                     "overlap_collectives": False}
+
+    def test_depth_clamped_non_negative(self):
+        cfg = AttrDict(trainer=AttrDict(pipeline=AttrDict(depth=-1)))
+        assert pipeline_settings(cfg)["depth"] == 0
+
+
+class TestHoistInvariants:
+    def test_no_constants_is_noop(self):
+        data = {"x": np.ones(3)}
+        out, nbytes = hoist_invariants(data, {})
+        assert out is data and nbytes == 0
+
+    def test_trivial_mesh_is_noop(self):
+        from imaginaire_tpu.parallel.mesh import create_mesh
+
+        mesh = create_mesh(("data",), (1,), devices=jax.devices()[:1])
+        data = {"x": np.ones(3, np.float32)}
+        out, nbytes = hoist_invariants(data, dict(data), mesh=mesh)
+        assert nbytes == 0
+
+    def test_sharded_operand_gathers_once_to_replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from imaginaire_tpu.parallel.mesh import create_mesh
+
+        mesh = create_mesh(("data",))
+        sharded = jax.device_put(
+            np.arange(32, dtype=np.float32).reshape(8, 4),
+            NamedSharding(mesh, PartitionSpec("data")))
+        data = {"ref": sharded, "skip": None}
+        out, nbytes = hoist_invariants(
+            data, {"ref": sharded, "skip": None}, mesh=mesh)
+        assert nbytes == sharded.nbytes
+        replicated = NamedSharding(mesh, PartitionSpec())
+        assert out["ref"].sharding.is_equivalent_to(replicated, 2)
+        np.testing.assert_array_equal(
+            np.asarray(out["ref"]), np.asarray(sharded))
+        # second hoist sees the replicated operand and gathers nothing
+        out, nbytes = hoist_invariants(out, {"ref": out["ref"]}, mesh=mesh)
+        assert nbytes == 0
+
+
+def _build_trainer(tmp_path, tag, **trainer_overrides):
+    cfg = Config(CFG)
+    cfg.logdir = str(tmp_path / tag)
+    # shrink the perceptual graph: equivalence, not capacity
+    cfg.trainer.perceptual_loss.layers = ["relu_1_1", "relu_2_1"]
+    cfg.trainer.perceptual_loss.weights = [0.5, 1.0]
+    for key, value in trainer_overrides.items():
+        setattr(cfg.trainer, key, value)
+    return resolve(cfg.trainer.type, "Trainer")(cfg)
+
+
+class TestEligibility:
+    def test_vid2vid_default_is_eligible(self, tmp_path):
+        trainer = _build_trainer(tmp_path, "elig")
+        assert trainer._pipeline_eligible({}, 3)
+
+    def test_knob_off_or_depth_zero_refuses(self, tmp_path):
+        trainer = _build_trainer(
+            tmp_path, "off", pipeline=AttrDict(enabled=False))
+        assert not trainer._pipeline_eligible({}, 3)
+        trainer = _build_trainer(
+            tmp_path, "d0", pipeline=AttrDict(depth=0))
+        assert not trainer._pipeline_eligible({}, 3)
+
+    def test_rollback_policy_refuses(self, tmp_path):
+        """rollback snapshots state per observation; deferring the
+        observation past later frames' mutations would snapshot the
+        wrong state, so the pipeline must stand down."""
+        trainer = _build_trainer(tmp_path, "rb")
+        trainer.diag.on_nonfinite = "rollback"
+        assert not trainer._pipeline_eligible({}, 3)
+
+    def test_wc_vid2vid_never_pipelines(self):
+        from imaginaire_tpu.trainers import wc_vid2vid
+
+        assert wc_vid2vid.Trainer._pipeline_eligible(object(), {}, 3) \
+            is False
+
+
+@pytest.mark.slow
+class TestPipelinedParity:
+    """The acceptance bar: the pipelined rollout is bit-identical to the
+    sequential loop in fp32 — losses, params, optimizer and EMA state,
+    every leaf — because only host poll TIMING changes; programs, inputs
+    and observation order do not."""
+
+    def _run(self, tmp_path, tag, pipeline, iters=2):
+        from tests.test_vid2vid import video_batch
+
+        trainer = _build_trainer(
+            tmp_path, tag,
+            pipeline=AttrDict(**pipeline),
+            model_average=True,
+            model_average_start_iteration=0,
+            model_average_beta=0.5,
+        )
+        data = video_batch(np.random.RandomState(7), t=4)
+        trainer.init_state(jax.random.PRNGKey(0), data)
+        losses = None
+        for it in range(1, iters + 1):
+            batch = trainer.start_of_iteration(dict(data), it)
+            losses = trainer.gen_update(batch)
+        return ({k: float(jax.device_get(v)) for k, v in losses.items()},
+                jax.device_get(trainer.state))
+
+    def test_bit_parity_and_zero_recompiles(self, tmp_path):
+        from imaginaire_tpu.telemetry import xla_obs
+
+        losses_seq, state_seq = self._run(
+            tmp_path, "seq", {"enabled": False})
+        losses_pipe, state_pipe = self._run(
+            tmp_path, "pipe",
+            {"enabled": True, "depth": 2, "overlap_collectives": True})
+        assert set(losses_seq) == set(losses_pipe)
+        for k in losses_seq:
+            assert losses_pipe[k] == losses_seq[k], (
+                f"loss {k!r}: pipelined {losses_pipe[k]!r} != "
+                f"sequential {losses_seq[k]!r}")
+        leaves_seq, tree_seq = jax.tree_util.tree_flatten(state_seq)
+        leaves_pipe, tree_pipe = jax.tree_util.tree_flatten(state_pipe)
+        assert tree_seq == tree_pipe
+        assert len(leaves_seq) > 0
+        for i, (a, b) in enumerate(zip(leaves_seq, leaves_pipe)):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                f"state leaf {i} diverged under the pipelined dispatch")
+        # EMA coverage: model_average=True put an ema_G collection in
+        # the compared state
+        assert "ema_G" in state_seq
+
+        # zero post-warmup recompiles through the compile ledger: the
+        # ring-buffer growth recompiles all land inside iteration 1's
+        # gen_update; a fresh trainer run two iterations deep is in
+        # steady state, and one more pipelined rollout must not add a
+        # single compile or recompile
+        trainer = _build_trainer(
+            tmp_path, "ledger",
+            pipeline=AttrDict(enabled=True, depth=2),
+        )
+        from tests.test_vid2vid import video_batch
+
+        data = video_batch(np.random.RandomState(7), t=4)
+        trainer.init_state(jax.random.PRNGKey(0), data)
+        for it in range(1, 3):
+            batch = trainer.start_of_iteration(dict(data), it)
+            trainer.gen_update(batch)
+        mark = xla_obs.ledger().snapshot()
+        batch = trainer.start_of_iteration(dict(data), 3)
+        trainer.gen_update(batch)
+        steady = xla_obs.snapshot_delta(mark)
+        assert steady["recompiles"] == 0, steady
+        assert steady["compiles"] == 0, steady
