@@ -66,9 +66,23 @@ def _leg_summary(tm, xla_mark=None, trainer=None):
         out["xla"] = _xla_leg(xla_mark)
     if trainer is not None:
         out["precision"] = _precision_leg(trainer)
+    out["ops"] = _ops_leg()
     out["resilience"] = _resilience_leg()
     out.update(_pipeline_leg(tm))
     return out
+
+
+def _ops_leg():
+    """The resolved ops implementation map for one bench leg (ISSUE 16):
+    what ``implementation='auto'`` dispatched to for every native op
+    (``{spade_modulation: fused, correlation: mxu, ...}``), so BENCH
+    rows are attributable to kernel choices."""
+    try:
+        from imaginaire_tpu import ops
+
+        return ops.resolved_implementations()
+    except Exception:  # noqa: BLE001 — bench accounting is best-effort
+        return None
 
 
 def _pipeline_leg(tm):

@@ -22,6 +22,42 @@ from imaginaire_tpu.analysis import islands
 from imaginaire_tpu.layers import hyper_ops
 
 
+def _fusable_modulation(impl, base_norm, x, pairs, masked=False):
+    """Whether the SPADE epilogue can route through the fused
+    ``ops.spade_modulation`` op (ISSUE 16). Refusal cases fall back to
+    the unfused composition: the op implements *instance*-norm
+    statistics only, needs full-spatial γ/β maps (AdaptiveNorm's
+    'linear' broadcast refuses via the shape check), and the
+    ``partial=True`` masked path stays on the reference composition."""
+    if impl in ("", "none", "off", "unfused", None):
+        return False
+    if masked or base_norm != "instance" or x.ndim != 4 or not pairs:
+        return False
+    return all(
+        tuple(g.shape) == tuple(x.shape) == tuple(b.shape)
+        for g, b in pairs)
+
+
+def default_fused_modulation(anp, remat):
+    """Generator-side default for the epilogue-fusion knob, given the
+    model's remat policy. Measured (PROFILE.md ISSUE-16, spade-512
+    bs4): ``custom_vjp`` residuals are OPAQUE to ``jax.checkpoint``, so
+    inside a rematted block the fused op pins (x, γ, stats) residuals
+    the block policy would otherwise discard and recompute — fusion
+    and block-remat are alternative mechanisms for the same residuals,
+    not additive (fused+blocks: 22.61 GiB at baseline flops vs
+    unfused+blocks 22.09 GiB at +4% flops). So under an enabled remat
+    policy the default is 'none'; an explicit config knob always wins
+    (memory_autotune sets it explicitly to measure both arms)."""
+    from imaginaire_tpu.optim.remat import resolve_policy
+
+    anp = dict(anp)
+    if "fused_modulation" not in anp \
+            and resolve_policy(remat, where="gen.remat").enabled:
+        anp["fused_modulation"] = "none"
+    return anp
+
+
 def _resize(x, hw, method="nearest"):
     b, h, w, c = x.shape
     if (h, w) == tuple(hw):
@@ -151,14 +187,14 @@ class AdaptiveNorm(nn.Module):
     separate_projection: bool = False
     projection_bias: bool = True
     weight_norm_type: str = ""
+    fused_modulation: str = "auto"  # ops.spade_modulation implementation
 
     @nn.compact
     def __call__(self, x, cond, training=False):
         from imaginaire_tpu.layers.conv import LinearBlock
+        from imaginaire_tpu.ops.spade_modulation import spade_modulation
 
         c = x.shape[-1]
-        norm = _base_norm(self.base_norm, affine=False)
-        y = norm(x, training=training)
 
         def dense(feats, name):
             return LinearBlock(feats, bias=self.projection_bias, order="C",
@@ -178,6 +214,14 @@ class AdaptiveNorm(nn.Module):
         else:
             gb = nn.Conv(2 * c, (1, 1), use_bias=self.projection_bias, name="conv")(cond)
             gamma, beta = jnp.split(gb, 2, axis=-1)
+        # the spatially-broadcast ('conv' projection) case fuses the
+        # norm->modulate epilogue; the 'linear' broadcast maps refuse via
+        # the full-spatial shape check (ISSUE 16)
+        if _fusable_modulation(self.fused_modulation, self.base_norm, x,
+                               [(gamma, beta)]):
+            return spade_modulation(x, [gamma], [beta],
+                                    implementation=self.fused_modulation)
+        y = _base_norm(self.base_norm, affine=False)(x, training=training)
         return y * (1.0 + gamma) + beta
 
 
@@ -198,21 +242,22 @@ class SpatiallyAdaptiveNorm(nn.Module):
     partial: bool = False
     interpolation: str = "nearest"
     weight_norm_type: str = ""
+    fused_modulation: str = "auto"  # ops.spade_modulation implementation
 
     @nn.compact
     def __call__(self, x, *cond_inputs, training=False):
         from imaginaire_tpu.layers.conv import Conv2dBlock, PartialConv2d
+        from imaginaire_tpu.ops.spade_modulation import spade_modulation
 
         c = x.shape[-1]
         hw = x.shape[1:3]
-        y = _base_norm(self.base_norm, affine=False)(x, training=training)
 
         def conv(feats, name):
             return Conv2dBlock(feats, kernel_size=self.kernel_size, order="C",
                                weight_norm_type=self.weight_norm_type, name=name)
 
-        gamma_sum = None
-        beta_sum = None
+        pairs = []
+        masked = False
         for i, cond in enumerate(cond_inputs):
             if cond is None:
                 continue
@@ -227,6 +272,7 @@ class SpatiallyAdaptiveNorm(nn.Module):
                     self.num_filters, self.kernel_size, name=f"mlp_{i}"
                 )(cond, mask)
                 hidden = nn.relu(hidden)
+                masked = True
             elif self.num_filters > 0:
                 hidden = nn.relu(conv(self.num_filters, f"mlp_{i}")(cond, training=training))
             else:
@@ -237,6 +283,20 @@ class SpatiallyAdaptiveNorm(nn.Module):
             else:
                 gb = conv(2 * c, f"gb_{i}")(hidden, training=training)
                 gamma, beta = jnp.split(gb, 2, axis=-1)
+            pairs.append((gamma, beta))
+        if _fusable_modulation(self.fused_modulation, self.base_norm, x,
+                               pairs, masked=masked):
+            # the whole multi-cond accumulation fuses: norm(x), Σγ and
+            # Σβ never materialize (ops/spade_modulation.py, ISSUE 16).
+            # The base norm here is the paramless InstanceNorm, so the
+            # param tree is identical across implementations.
+            return spade_modulation(x, [g for g, _ in pairs],
+                                    [b for _, b in pairs],
+                                    implementation=self.fused_modulation)
+        y = _base_norm(self.base_norm, affine=False)(x, training=training)
+        gamma_sum = None
+        beta_sum = None
+        for gamma, beta in pairs:
             gamma_sum = gamma if gamma_sum is None else gamma_sum + gamma
             beta_sum = beta if beta_sum is None else beta_sum + beta
         if gamma_sum is None:
@@ -256,13 +316,15 @@ class HyperSpatiallyAdaptiveNorm(nn.Module):
     num_filters: int = 0
     kernel_size: int = 3
     base_norm: str = "instance"
+    fused_modulation: str = "auto"  # ops.spade_modulation implementation
 
     @nn.compact
     def __call__(self, x, *cond_inputs, norm_weights=None, training=False):
+        from imaginaire_tpu.ops.spade_modulation import spade_modulation
+
         c = x.shape[-1]
         hw = x.shape[1:3]
-        y = _base_norm(self.base_norm, affine=False)(x, training=training)
-        out = y
+        pairs = []  # (gamma, beta, had_mask)
         for i, cond in enumerate(cond_inputs):
             if cond is None:
                 continue
@@ -291,6 +353,22 @@ class HyperSpatiallyAdaptiveNorm(nn.Module):
             if mask is not None:
                 gamma = gamma * (1 - mask)
                 beta = beta * (1 - mask)
+            pairs.append((gamma, beta, mask is not None))
+        # The combine here is SEQUENTIAL per condition (not summed), so
+        # only the first γ/β pair — the one applied directly to norm(x),
+        # incl. the runtime-weight path — fuses with the normalization;
+        # a masked first pair refuses (ISSUE 16).
+        start = 0
+        if pairs and _fusable_modulation(
+                self.fused_modulation, self.base_norm, x,
+                [pairs[0][:2]], masked=pairs[0][2]):
+            out = spade_modulation(x, [pairs[0][0]], [pairs[0][1]],
+                                   implementation=self.fused_modulation)
+            start = 1
+        else:
+            out = _base_norm(self.base_norm, affine=False)(x,
+                                                           training=training)
+        for gamma, beta, _ in pairs[start:]:
             out = out * (1.0 + gamma) + beta
         return out
 
@@ -339,6 +417,7 @@ def get_activation_norm_layer(norm_type, norm_params=None, name=None):
             base_norm=p.get("activation_norm_type", "instance"),
             separate_projection=p.get("separate_projection", False),
             weight_norm_type=p.get("weight_norm_type", ""),
+            fused_modulation=p.get("fused_modulation", "auto"),
             **kw,
         )
     if norm_type == "spatially_adaptive":
@@ -350,6 +429,7 @@ def get_activation_norm_layer(norm_type, norm_params=None, name=None):
             partial=p.get("partial", False),
             interpolation=p.get("interpolation", "nearest"),
             weight_norm_type=p.get("weight_norm_type", ""),
+            fused_modulation=p.get("fused_modulation", "auto"),
             **kw,
         )
     if norm_type == "hyper_spatially_adaptive":
@@ -357,6 +437,7 @@ def get_activation_norm_layer(norm_type, norm_params=None, name=None):
             num_filters=p.get("num_filters", 0),
             kernel_size=p.get("kernel_size", 3),
             base_norm=p.get("activation_norm_type", "instance"),
+            fused_modulation=p.get("fused_modulation", "auto"),
             **kw,
         )
     raise ValueError(f"unknown activation norm {norm_type!r}")

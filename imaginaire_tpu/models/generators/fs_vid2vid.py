@@ -25,6 +25,7 @@ from flax import linen as nn
 
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.layers import Conv2dBlock, HyperRes2dBlock, LinearBlock, Res2dBlock
+from imaginaire_tpu.layers.activation_norm import default_fused_modulation
 from imaginaire_tpu.model_utils.fs_vid2vid import (
     extract_valid_pose_labels,
     fold_time,
@@ -500,6 +501,7 @@ class Generator(nn.Module):
                                        {}) or {}))
         order = cfg_get(hyper_cfg, "hyper_block_order", "NAC")
         self.remat = cfg_get(gen_cfg, "remat", "none")
+        anp = default_fused_modulation(anp, self.remat)
 
         # setup-based module: store wrapped INSTANCES on self (flax
         # registers modules reachable through lists, not closures); the

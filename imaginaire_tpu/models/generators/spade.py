@@ -32,6 +32,7 @@ from flax import linen as nn
 from imaginaire_tpu.utils.misc import upsample_2x
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.layers import Conv2dBlock, LinearBlock, Res2dBlock
+from imaginaire_tpu.layers.activation_norm import default_fused_modulation
 from imaginaire_tpu.optim.remat import remat_block
 from imaginaire_tpu.utils.data import (
     get_crop_or_resize_h_w,
@@ -76,6 +77,8 @@ class Generator(nn.Module):
         anp.setdefault("activation_norm_type", "sync_batch")
         anp.setdefault("separate_projection", False)
         anp.setdefault("weight_norm_type", weight_norm_type)
+        anp = default_fused_modulation(anp, cfg_get(gen_cfg, "remat",
+                                                    "none"))
 
         self.spade_generator = SPADEGenerator(
             num_labels=num_labels,

@@ -27,6 +27,7 @@ from flax import linen as nn
 
 from imaginaire_tpu.config import as_attrdict, cfg_get
 from imaginaire_tpu.layers import Conv2dBlock, LinearBlock, Res2dBlock
+from imaginaire_tpu.layers.activation_norm import default_fused_modulation
 from imaginaire_tpu.model_utils.fs_vid2vid import fold_time, resample
 from imaginaire_tpu.models.generators.embedders import LabelEmbedder
 from imaginaire_tpu.optim.remat import call_block, remat_block, remat_block_cls
@@ -175,6 +176,7 @@ class Generator(nn.Module):
             return min(self.max_num_filters, self.num_filters * (2 ** i))
 
         self.remat = cfg_get(gen_cfg, "remat", "none")
+        anp = default_fused_modulation(anp, self.remat)
 
         def res_block(ch, name):
             # setup-based module: the wrapped INSTANCE is stored on self

@@ -1,24 +1,70 @@
 """TPU-native equivalents of the reference's CUDA extensions.
 
 Reference ops (ref: imaginaire/third_party/):
-  resample2d  — flow-based backward warping (resample2d_kernel.cu)
-  channelnorm — per-pixel L-p norm across channels (channelnorm_kernel.cu)
-  correlation — FlowNetC cost volume (correlation_cuda_kernel.cu)
+  resample2d       — flow-based backward warping (resample2d_kernel.cu)
+  channelnorm      — per-pixel L-p norm across channels (channelnorm_kernel.cu)
+  correlation      — FlowNetC cost volume (correlation_cuda_kernel.cu)
+  spade_modulation — fused SPADE norm->modulate epilogue (ISSUE 16; the
+                     reference composes this from stock ops, but the
+                     synthesis hot path's ``norm(x) * (1 + Σγ) + Σβ``
+                     materializes three full-size tensors the fused op
+                     keeps out of HBM)
 
 Each op has a pure-jnp implementation (differentiable; XLA autodiff turns
 the gather-style forward into the scatter-add backward the CUDA code does
 with atomicAdd) and a Pallas TPU kernel reachable via
-``implementation='pallas'``. ``implementation='auto'`` follows on-chip
-measurement (OPSBENCH.json, scripts/opsbench.py): resample2d and
+``implementation='pallas'``. ``implementation='auto'`` follows measured
+dispatch (OPSBENCH.json, scripts/opsbench.py): resample2d and
 channelnorm pin to the jnp/XLA path (XLA beat or outlived the
 hand-written kernels at every production shape); correlation pins to the
 'mxu' formulation — the cost volume recast as per-displacement-row
 matmuls plus a strided band-gather, 2.1x the scan path at FlowNetC's
-full shape — with the scan path covering general kernel sizes.
+full shape — with the scan path covering general kernel sizes;
+spade_modulation pins to 'fused' (the custom_vjp residual-trimming path,
+currently CPU-measured / chip-pending).
+
+auto decision-table refresh protocol
+------------------------------------
+Each op module carries an ``AUTO_IMPLEMENTATION`` constant that MUST be
+backed by an OPSBENCH.json row, never asserted by fiat. To refresh:
+
+  1. run ``python scripts/opsbench.py`` (optionally ``--ops <op,...>``)
+     on the target hardware; residual-policy ops (spade_modulation)
+     are benched on the grad path and their rows carry the grad
+     program's AOT ``temp_bytes`` — the winner for such ops orders by
+     (temp bytes, then latency), since identical forward math makes
+     latency alone noise;
+  2. on a real chip (platform 'tpu') the run is authoritative: it
+     rewrites the decision table and may change any pin;
+  3. off-chip runs (CPU containers) MERGE instead: their rows land
+     tagged ``chip_pending: true`` and may only pin ops the chip has
+     never measured — a CPU row never overwrites a chip-measured
+     winner (scripts/opsbench.py ``merge_report``);
+  4. update the op's ``AUTO_IMPLEMENTATION`` + dispatch comment to cite
+     the new row, and keep ``tests/test_spade_modulation.py``'s
+     pin-vs-OPSBENCH consistency check passing.
 """
 
 from imaginaire_tpu.ops.resample2d import resample2d
 from imaginaire_tpu.ops.channelnorm import channelnorm
 from imaginaire_tpu.ops.correlation import correlation
+from imaginaire_tpu.ops.spade_modulation import spade_modulation
 
-__all__ = ["resample2d", "channelnorm", "correlation"]
+
+def resolved_implementations():
+    """{op: implementation} each op's ``implementation='auto'`` resolves
+    to — the single source is each module's ``AUTO_IMPLEMENTATION``
+    constant. Bench legs record this map so BENCH rows are attributable
+    to kernel choices (ISSUE 16)."""
+    import importlib
+
+    return {
+        op: importlib.import_module(f"imaginaire_tpu.ops.{op}")
+        .AUTO_IMPLEMENTATION
+        for op in ("resample2d", "channelnorm", "correlation",
+                   "spade_modulation")
+    }
+
+
+__all__ = ["resample2d", "channelnorm", "correlation", "spade_modulation",
+           "resolved_implementations"]

@@ -15,6 +15,10 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+# The measured 'auto' pin (TPU v5e, OPSBENCH.json) — see the dispatch
+# comment below; bench legs record this via ops.resolved_implementations().
+AUTO_IMPLEMENTATION = "jnp"
+
 
 def _channelnorm_jnp(x, p):
     if p == 2:
@@ -31,7 +35,7 @@ def channelnorm(x, p=2, implementation="auto"):
         # reduce and sqrt, while the kernel's (N, C) layout idles
         # 128-wide lanes at the common C=2-3. Numbers live in
         # OPSBENCH.json; re-run scripts/opsbench.py before changing this.
-        implementation = "jnp"
+        implementation = AUTO_IMPLEMENTATION
     if implementation == "jnp":
         return _channelnorm_jnp(x, p)
     if implementation in ("pallas", "pallas_interpret"):

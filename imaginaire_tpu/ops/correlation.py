@@ -25,6 +25,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+# The measured 'auto' pin (TPU v5e, OPSBENCH.json) for the FlowNetC
+# configuration; shapes the mxu band grid cannot represent fall back to
+# 'jnp' in the dispatch below. Bench legs record this via
+# ops.resolved_implementations().
+AUTO_IMPLEMENTATION = "mxu"
+
 
 def _displacement_grid(max_displacement, stride2):
     steps = np.arange(-max_displacement, max_displacement + 1, stride2, dtype=np.int32)
@@ -119,8 +125,9 @@ def correlation(
         # (1,64,128,256) and 0.15ms vs 0.98ms at (1,32,64,256) — so it
         # is the pinned default for the FlowNetC configuration; the scan
         # path serves general kernel_size/stride1.
-        implementation = "mxu" if (kernel_size == 1 and stride1 == 1
-                                   and max_displacement % stride2 == 0) \
+        implementation = AUTO_IMPLEMENTATION \
+            if (kernel_size == 1 and stride1 == 1
+                and max_displacement % stride2 == 0) \
             else "jnp"
     if implementation == "mxu":
         if kernel_size != 1 or stride1 != 1 \

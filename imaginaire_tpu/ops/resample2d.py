@@ -28,6 +28,10 @@ import functools
 import jax
 import jax.numpy as jnp
 
+# The measured 'auto' pin (TPU v5e, OPSBENCH.json) — see the dispatch
+# comment below; bench legs record this via ops.resolved_implementations().
+AUTO_IMPLEMENTATION = "jnp"
+
 
 def _bilinear_warp(x, flow):
     """Differentiable jnp implementation (B, H, W, C) x (B, H, W, 2)."""
@@ -97,7 +101,7 @@ def resample2d(x, flow, implementation="auto"):
         # compiles at, and the kernel fails to compile (VMEM) at vid2vid
         # warp shapes — jnp is the winner everywhere. Numbers live in
         # OPSBENCH.json; re-run scripts/opsbench.py before changing this.
-        implementation = "jnp"
+        implementation = AUTO_IMPLEMENTATION
     if implementation == "jnp":
         return _bilinear_warp(x, flow)
     if implementation == "pallas":

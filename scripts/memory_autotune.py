@@ -45,13 +45,27 @@ class MemoryBudgetError(RuntimeError):
 # --------------------------------------------------------------- pure core
 
 
-def enumerate_candidates(policies, dtypes, batch_sizes):
+MODULATIONS = ("fused", "unfused")
+
+
+def enumerate_candidates(policies, dtypes, batch_sizes, modulations=None):
     """The candidate grid, validated: every policy name must resolve in
     the shared registry (one error message, one registry — the same
     resolver the generators use) and every dtype must be a known
-    compute dtype."""
+    compute dtype.
+
+    ``modulations`` (ISSUE 16) adds the fused-SPADE-epilogue axis:
+    'fused' routes the generator's SPADE epilogues through
+    ``ops.spade_modulation`` ('fused' implementation), 'unfused' pins
+    the reference composition ('none'). When None (the default) the
+    axis is absent and candidate names keep their PR-9 shape."""
     from imaginaire_tpu.optim.remat import resolve_policy
 
+    for mod in modulations or ():
+        if mod not in MODULATIONS:
+            raise ValueError(
+                f"memory_autotune --modulations={mod!r} is not a known "
+                f"modulation mode; use one of " + ", ".join(MODULATIONS))
     out = []
     for policy in policies:
         resolve_policy(policy, where="memory_autotune --policies")
@@ -63,19 +77,29 @@ def enumerate_candidates(policies, dtypes, batch_sizes):
             for bs in batch_sizes:
                 if int(bs) < 1:
                     raise ValueError(f"batch size must be >= 1, got {bs}")
-                out.append({
-                    "name": f"{policy}/{dtype}/bs{int(bs)}",
-                    "remat_policy": policy,
-                    "compute_dtype": dtype,
-                    "batch_size": int(bs),
-                })
+                for mod in (modulations or (None,)):
+                    cand = {
+                        "name": f"{policy}/{dtype}/bs{int(bs)}",
+                        "remat_policy": policy,
+                        "compute_dtype": dtype,
+                        "batch_size": int(bs),
+                    }
+                    if mod is not None:
+                        cand["name"] += f"/{mod}"
+                        cand["spade_modulation"] = mod
+                    out.append(cand)
     return out
 
 
 def _measured(rows):
+    """Rows eligible for pareto/recommendation: compiled cleanly AND
+    were not legalized away from the requested dtype (ISSUE 16: CPU
+    lowers bf16 convs through f32, inflating temp by ~24% — those rows
+    are recorded for the table but must not compete as candidates)."""
     return [r for r in rows
             if r.get("temp_bytes") is not None
-            and r.get("flops") is not None]
+            and r.get("flops") is not None
+            and not r.get("legalized")]
 
 
 def pareto_frontier(rows):
@@ -139,6 +163,8 @@ def profile_rows(family, hw, rows, frontier_names, recommended_name):
         if r.get("temp_bytes") is None:
             continue
         marks = []
+        if r.get("legalized"):
+            marks.append("legalized")
         if r["name"] in frontier_names:
             marks.append("pareto")
         if r["name"] == recommended_name:
@@ -325,9 +351,18 @@ FAMILIES = {
 
 def _apply_candidate(cfg, cand):
     """Inject one candidate's knobs into a family config: the shared
-    per-block remat policy on BOTH nets and the end-to-end precision
+    per-block remat policy on BOTH nets, the end-to-end precision
     policy (mixed_precision wins over the legacy scalar in
-    BaseTrainer.__init__; both are set so either resolution path agrees)."""
+    BaseTrainer.__init__; both are set so either resolution path
+    agrees), and — when the candidate carries the ISSUE-16 modulation
+    axis — the fused-SPADE-epilogue knob. The fused op implements
+    instance-norm statistics only, so the axis also pins the SPADE base
+    norm to 'instance' on BOTH arms (fused AND unfused) to keep the
+    comparison apples-to-apples; rows from such sweeps are therefore
+    not directly comparable to sync_batch-base rows (PROFILE.md notes
+    this next to the ISSUE-16 table)."""
+    from imaginaire_tpu.config import cfg_get
+
     cfg.gen.remat = cand["remat_policy"]
     cfg.dis.remat = cand["remat_policy"]
     cfg.trainer.compute_dtype = cand["compute_dtype"]
@@ -335,6 +370,12 @@ def _apply_candidate(cfg, cand):
         "enabled": cand["compute_dtype"] != "float32",
         "compute_dtype": cand["compute_dtype"],
     }
+    mod = cand.get("spade_modulation")
+    if mod:
+        anp = dict(cfg_get(cfg.gen, "activation_norm_params", None) or {})
+        anp["activation_norm_type"] = "instance"
+        anp["fused_modulation"] = "fused" if mod == "fused" else "none"
+        cfg.gen.activation_norm_params = anp
     return cfg
 
 
@@ -398,9 +439,15 @@ def measure_candidate(family, hw, cand, mesh):
         print(f"# AOT {family} {cand['name']}: compiling {label} ...",
               flush=True)
         executables[label] = prog.aot_compile(state_sds, batch_sds)
-    return row_from_ledger(cand, family, hw, executables,
-                           xla_obs.ledger_flops(),
-                           _tree_bytes(state_shapes))
+    row = row_from_ledger(cand, family, hw, executables,
+                          xla_obs.ledger_flops(),
+                          _tree_bytes(state_shapes))
+    if cand["compute_dtype"] != "float32" and jax.default_backend() != "tpu":
+        # the CPU backend legalizes bf16 convs through f32 (+~24% temp,
+        # PROFILE.md ISSUE-10): record the row but bar it from
+        # pareto/recommendation (ISSUE 16)
+        row["legalized"] = True
+    return row
 
 
 def main(argv=None):
@@ -417,6 +464,10 @@ def main(argv=None):
     ap.add_argument("--policies",
                     default="none,blocks,dots_saveable,save_nothing")
     ap.add_argument("--dtypes", default="float32,bfloat16")
+    ap.add_argument("--modulations", default=None,
+                    help="comma list from " + ",".join(MODULATIONS)
+                         + " — adds the fused-SPADE-epilogue axis "
+                           "(ISSUE 16); omitted by default")
     ap.add_argument("--mem-budget-frac", type=float, default=0.9)
     ap.add_argument("--devices", type=int, default=1,
                     help="virtual CPU mesh size (data axis)")
@@ -456,9 +507,17 @@ def main(argv=None):
 
     policies = [p.strip() for p in args.policies.split(",") if p.strip()]
     dtypes = [d.strip() for d in args.dtypes.split(",") if d.strip()]
-    report = {"mem_budget_frac": args.mem_budget_frac,
-              "bytes_limit": bytes_limit, "devices": n_dev,
-              "families": {}}
+    modulations = ([m.strip() for m in args.modulations.split(",")
+                    if m.strip()] if args.modulations else None)
+    # re-sweeping one family must not drop the others' rows: start from
+    # the existing report and update the swept families in place
+    report = {"families": {}}
+    if args.json and os.path.exists(args.json):
+        with open(args.json) as f:
+            report = json.load(f)
+        report.setdefault("families", {})
+    report.update(mem_budget_frac=args.mem_budget_frac,
+                  bytes_limit=bytes_limit, devices=n_dev)
     md = ["| family | remat | dtype | bs | temp | flops | verdict |",
           "|---|---|---|---|---|---|---|"]
     for family in families:
@@ -466,8 +525,18 @@ def main(argv=None):
         hw = tuple(args.hw) if args.hw else default_hw
         batch_sizes = ([int(b) for b in args.bs.split(",")]
                        if args.bs else [default_bs])
-        cands = enumerate_candidates(policies, dtypes, batch_sizes)
+        cands = enumerate_candidates(policies, dtypes, batch_sizes,
+                                     modulations=modulations)
         rows = [measure_candidate(family, hw, c, mesh) for c in cands]
+        # union with the family's prior rows at the same resolution
+        # (same-name rows refresh in place) so a narrow re-sweep — e.g.
+        # the ISSUE-16 modulation axis — extends the table instead of
+        # discarding the PR-9 sweep
+        prior_family = report["families"].get(family) or {}
+        if list(prior_family.get("hw", ())) == list(hw):
+            by_name = {r["name"]: r for r in prior_family.get("rows", ())}
+            by_name.update({r["name"]: r for r in rows})
+            rows = list(by_name.values())
         front = pareto_frontier(rows)
         front_names = [r["name"] for r in front]
         try:

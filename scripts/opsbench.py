@@ -3,8 +3,11 @@
 
 Writes OPSBENCH.json at the repo root: per (op, impl, shape) median
 latency, plus the measured winner per op. ``implementation='auto'`` in
-ops/{resample2d,channelnorm,correlation}.py is pinned to these winners —
-re-run this script on new hardware before changing the dispatch.
+ops/{resample2d,channelnorm,correlation,spade_modulation}.py is pinned
+to these winners — re-run this script on new hardware before changing
+the dispatch. Off-chip (CPU) runs merge instead of overwrite: their
+rows are tagged ``chip_pending`` and can only pin ops the chip has
+never measured (``merge_report``; protocol in ops/__init__.py).
 
 Shapes are the vid2vid operating points (ref: the reference runs FlowNet2
 on 512x1024 cityscapes frames; FlowNetC's cost volume runs at 1/8 res
@@ -17,6 +20,7 @@ dispatch, so a device-to-host readback is the only reliable fence.
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import re
@@ -82,16 +86,33 @@ def _sanitize(msg):
     return msg.splitlines()[0][:200] if msg else msg
 
 
-def _run_case(cases, op, impl, shape, thunk, *args):
+def _run_case(cases, op, impl, shape, thunk, *args, extras=None):
     try:
         ms = measure(thunk, *args)
+        row = {"op": op, "impl": impl, "shape": list(shape),
+               "ms": round(ms, 4)}
+        if extras is not None:
+            row.update(extras())
     except Exception as e:  # noqa: BLE001 - record compile failures as data
         cases.append({"op": op, "impl": impl, "shape": list(shape),
                       "error": _sanitize(str(e))})
     else:
-        cases.append({"op": op, "impl": impl, "shape": list(shape),
-                      "ms": round(ms, 4)})
+        cases.append(row)
     print(cases[-1], flush=True)
+
+
+def _grad_program_temp_bytes(fn, *args):
+    """XLA temp allocation of the op's training-path program
+    (fwd + grad wrt every input), from AOT memory_analysis — the axis a
+    residual-policy op actually trades on. Latency cannot separate
+    implementations whose forward math is identical (spade_modulation
+    'jnp' vs 'fused'); their difference is what the backward keeps."""
+    def loss(*a):
+        return jnp.sum(fn(*a).astype(jnp.float32) ** 2)
+
+    grad = jax.jit(jax.grad(loss, argnums=tuple(range(len(args)))))
+    ma = grad.lower(*args).compile().memory_analysis()
+    return int(ma.temp_size_in_bytes)
 
 
 def bench_resample2d(cases):
@@ -132,39 +153,150 @@ def bench_correlation(cases):
                       x1, x2)
 
 
-def main():
-    dev = jax.devices()[0]
-    print("device:", dev, flush=True)
-    cases = []
-    bench_resample2d(cases)
-    bench_channelnorm(cases)
-    bench_correlation(cases)
+def bench_spade_modulation(cases):
+    from imaginaire_tpu.ops.spade_modulation import spade_modulation
 
+    rng = np.random.RandomState(0)
+    # SPADE generator epilogue operating points at 512^2 synthesis: the
+    # deep low-res blocks (bs4 x 32^2 x 1024), the mid blocks and the
+    # wide near-output block; plus the 2-condition accumulation case
+    # (spade.py feeds seg + edge maps). Measured on the TRAINING path
+    # (grad of sum-of-squares wrt every input): the op exists to change
+    # what the backward keeps, and its rows carry the grad program's
+    # AOT temp bytes alongside latency — pick_winners orders
+    # temp-annotated ops by (temp, then ms).
+    shapes = (((4, 32, 32, 1024), 1), ((4, 128, 128, 256), 1),
+              ((2, 256, 256, 128), 1), ((4, 64, 64, 512), 2))
+    for shape, n_pairs in shapes:
+        x = jnp.asarray(rng.rand(*shape), jnp.float32)
+        gs = tuple(jnp.asarray(rng.randn(*shape) * 0.1, jnp.float32)
+                   for _ in range(n_pairs))
+        bs = tuple(jnp.asarray(rng.randn(*shape) * 0.1, jnp.float32)
+                   for _ in range(n_pairs))
+        for impl in ("jnp", "fused", "pallas"):
+            def op(x_, *gb, i=impl):
+                return spade_modulation(
+                    x_, gb[:len(gb) // 2], gb[len(gb) // 2:],
+                    implementation=i)
+
+            def grad_dx(x_, *gb):
+                # dx chains through _looped's data dependence; the full
+                # pytree grad would not
+                return jax.grad(
+                    lambda a: jnp.sum(op(a, *gb) ** 2))(x_)
+
+            grad_dx.__name__ = f"spade_modulation_{impl}_grad"
+            _run_case(cases, "spade_modulation", impl,
+                      shape + (n_pairs,), grad_dx, x, *gs, *bs,
+                      extras=lambda: {"temp_bytes":
+                                      _grad_program_temp_bytes(
+                                          op, x, *gs, *bs)})
+
+
+BENCHES = {
+    "resample2d": bench_resample2d,
+    "channelnorm": bench_channelnorm,
+    "correlation": bench_correlation,
+    "spade_modulation": bench_spade_modulation,
+}
+
+
+def pick_winners(cases, op_names):
+    """Per-op default from the measured rows. Ordering: if every
+    qualifying implementation's rows carry ``temp_bytes`` (residual-
+    policy ops benched on the grad path, e.g. spade_modulation), the
+    winner is min by (sum temp_bytes, sum ms) — implementations with
+    identical forward math differ in what the backward materializes,
+    not in latency, so temp is the decision axis and latency only
+    breaks ties. Otherwise min by sum ms as before."""
     winners = {}
-    for op in ("resample2d", "channelnorm", "correlation"):
+    for op in op_names:
         op_cases = [item for item in cases if item["op"] == op]
         shapes = {tuple(item["shape"]) for item in op_cases}
-        totals, failed = {}, set()
+        rows, failed = {}, set()
         for item in op_cases:
             if "ms" in item:
-                totals.setdefault(item["impl"], []).append(item["ms"])
+                rows.setdefault(item["impl"], []).append(item)
             else:
                 failed.add(item["impl"])
         # only an impl that ran EVERY shape cleanly can be the default;
         # then all qualifying sums cover the identical shape set
-        ran = {impl: sum(ms) for impl, ms in totals.items()
-               if impl not in failed and len(ms) == len(shapes)}
-        winners[op] = min(ran, key=ran.get) if ran else "jnp"
+        ran = {impl: rs for impl, rs in rows.items()
+               if impl not in failed and len(rs) == len(shapes)}
+        if not ran:
+            winners[op] = "jnp"
+            continue
+        if all("temp_bytes" in r for rs in ran.values() for r in rs):
+            key = {impl: (sum(r["temp_bytes"] for r in rs),
+                          sum(r["ms"] for r in rs))
+                   for impl, rs in ran.items()}
+        else:
+            key = {impl: sum(r["ms"] for r in rs)
+                   for impl, rs in ran.items()}
+        winners[op] = min(key, key=key.get)
+    return winners
+
+
+def merge_report(old, new):
+    """The auto decision-table refresh protocol (ops/__init__.py): a
+    chip run (platform 'tpu') is authoritative and replaces the table
+    wholesale; an off-chip run only ADDS — its cases land tagged
+    ``chip_pending: true`` and its winners pin only ops the chip has
+    never measured. A CPU row never overwrites a chip-measured winner."""
+    if old is None or new.get("platform") == "tpu":
+        return new
+    chip_ops = {c["op"] for c in old.get("cases", ())
+                if not c.get("chip_pending")}
+    merged = dict(old)
+    tagged = [dict(c, chip_pending=True, device=new["device"])
+              for c in new["cases"]]
+    rebenched = set(new["winners"])
+    merged["cases"] = ([c for c in old.get("cases", ())
+                        if not (c["op"] in rebenched
+                                and c.get("chip_pending"))]
+                       + tagged)
+    merged["winners"] = dict(old.get("winners", {}))
+    merged["chip_pending"] = sorted(
+        set(old.get("chip_pending", ())) |
+        (set(new["winners"]) - chip_ops))
+    for op, impl in new["winners"].items():
+        if op not in chip_ops:
+            merged["winners"][op] = impl
+    return merged
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ops", default=",".join(BENCHES),
+                    help="comma list of ops to bench (others keep their "
+                         "existing OPSBENCH.json rows)")
+    args = ap.parse_args(argv)
+    op_names = [o.strip() for o in args.ops.split(",") if o.strip()]
+    unknown = [o for o in op_names if o not in BENCHES]
+    if unknown:
+        ap.error(f"unknown ops {unknown}; choose from " + ",".join(BENCHES))
+
+    dev = jax.devices()[0]
+    print("device:", dev, flush=True)
+    cases = []
+    for op in op_names:
+        BENCHES[op](cases)
 
     out = {"device": str(dev), "platform": dev.platform,
            "method": f"slope between {K_SMALL}- and {K_LARGE}-iteration "
                      f"fori_loop chains, median of {REPEATS}",
-           "cases": cases, "winners": winners}
+           "cases": cases, "winners": pick_winners(cases, op_names)}
     path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                         "OPSBENCH.json")
+    old = None
+    if os.path.exists(path):
+        with open(path) as f:
+            old = json.load(f)
+    merged = merge_report(old, out)
     with open(path, "w") as f:
-        json.dump(out, f, indent=1)
-    print(json.dumps({"winners": winners}))
+        json.dump(merged, f, indent=1)
+    print(json.dumps({"winners": merged["winners"],
+                      "chip_pending": merged.get("chip_pending", [])}))
 
 
 if __name__ == "__main__":

@@ -42,6 +42,25 @@ class TestEnumeration:
         with pytest.raises(ValueError, match="batch size"):
             ma.enumerate_candidates(["none"], ["float32"], [0])
 
+    def test_modulation_axis_opt_in(self):
+        # ISSUE 16: the fused-SPADE axis doubles the grid and suffixes
+        # candidate names; omitting it keeps the PR-9 name shape so old
+        # MEMBENCH rows stay comparable
+        plain = ma.enumerate_candidates(["none"], ["float32"], [4])
+        assert [c["name"] for c in plain] == ["none/float32/bs4"]
+        assert "spade_modulation" not in plain[0]
+        both = ma.enumerate_candidates(["none"], ["float32"], [4],
+                                       modulations=["fused", "unfused"])
+        assert [c["name"] for c in both] \
+            == ["none/float32/bs4/fused", "none/float32/bs4/unfused"]
+        assert [c["spade_modulation"] for c in both] \
+            == ["fused", "unfused"]
+
+    def test_bad_modulation_loud(self):
+        with pytest.raises(ValueError, match="modulation"):
+            ma.enumerate_candidates(["none"], ["float32"], [1],
+                                    modulations=["pallas"])
+
 
 class TestFakeLedgerRows:
     def test_row_from_ledger_reduces_executables(self):
@@ -88,6 +107,13 @@ class TestPareto:
                 _row("b", temp=50, flops=10.0)]
         assert [r["name"] for r in ma.pareto_frontier(rows)] == ["b"]
 
+    def test_legalized_rows_never_on_frontier(self):
+        # ISSUE 16: a CPU-legalized bf16 row may look pareto-optimal but
+        # measured a different program than the dtype it claims
+        rows = [_row("bf16", temp=10, flops=1.0, legalized=True),
+                _row("f32", temp=50, flops=10.0)]
+        assert [r["name"] for r in ma.pareto_frontier(rows)] == ["f32"]
+
 
 class TestRecommend:
     def test_bigger_batch_wins_over_smaller_temp(self):
@@ -125,6 +151,14 @@ class TestRecommend:
         rows = [_row("huge", bs=4, footprint=10**15)]
         assert ma.recommend(rows, bytes_limit=None)["name"] == "huge"
 
+    def test_legalized_rows_excluded_from_recommendation(self):
+        rows = [_row("bf16-legal", bs=8, temp=10, flops=1.0,
+                     legalized=True),
+                _row("f32-real", bs=4, temp=90, flops=9.0)]
+        assert ma.recommend(rows)["name"] == "f32-real"
+        with pytest.raises(ma.MemoryBudgetError):
+            ma.recommend([rows[0]])
+
 
 class TestProfileRows:
     def test_winner_and_pareto_marked(self):
@@ -137,3 +171,10 @@ class TestProfileRows:
                                 "blocks/bfloat16/bs4")
         assert any("**winner**" in ln and "blocks" in ln for ln in lines)
         assert all(ln.startswith("| spade 512x512 |") for ln in lines)
+
+    def test_legalized_rows_marked_in_table(self):
+        rows = [_row("none/bfloat16/bs1", bs=1, temp=2**30, flops=1e12,
+                     remat_policy="none", compute_dtype="bfloat16",
+                     legalized=True)]
+        lines = ma.profile_rows("spade", (512, 512), rows, [], None)
+        assert len(lines) == 1 and "legalized" in lines[0]
