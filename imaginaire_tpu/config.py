@@ -236,6 +236,25 @@ def default_config():
             # spans that suspend the hang watchdog while open (long
             # FID/KID eval sweeps complete no training steps by design)
             watchdog_exempt_spans=["eval"],
+            # -- pod observability plane (telemetry/podview.py, ISSUE
+            # 17): each process publishes a per-step digest (step, wall
+            # t, p50 step ms, span ms, loss crc32) over the
+            # coordination KV store and aggregates peers into
+            # pod/step_skew_ms, pod/straggler/<p> and the
+            # pod/divergence sentinel. enabled="auto" activates exactly
+            # when the cluster layer is (multi-process with a KV
+            # client). divergence="auto" picks crc bit-identity for
+            # pure data-parallel fp32 runs and the EWMA relative-delta
+            # threshold for mp/bf16; stale_after_s=None inherits the
+            # cluster heartbeat timeout.
+            pod=AttrDict(
+                enabled="auto",
+                digest_every_n_steps=10,
+                history=8,  # digests kept per host in the KV record
+                divergence="auto",  # crc | ewma | off
+                ewma_rel_threshold=0.05,
+                stale_after_s=None,
+            ),
         ),
         # -- XLA compile ledger + device-memory observability
         # (telemetry/xla_obs.py): every labeled program (dis_step /
@@ -430,6 +449,16 @@ def default_config():
             stall_at_step=None,
             stall_process_index=0,
             stall_duration_s=30.0,
+            # divergence injection (ISSUE 17): perturb the OBSERVED
+            # loss stream of one process at the digest boundary. A
+            # healthy pod's cross-host all-reduce homogenizes any
+            # in-graph perturbation before the loss scalar exists, so
+            # the measurable signature of a desynced replica is a
+            # disagreeing observed loss — which is exactly what the
+            # podview divergence sentinel must trip on.
+            diverge_loss_at_step=None,
+            diverge_process_index=0,
+            diverge_scale=1e-3,
         ),
         # -- 2-D (data x model) parallelism (parallel/partition.py,
         # ISSUE 6). mesh_shape opts in: {"data": N, "model": M} (or an

@@ -191,6 +191,11 @@ class HealthMonitor:
                     tm.counter(ctr, lvals[key], step=step)
         self.history.append({"step": step, "kind": kind, "finite": finite,
                              "health": health, "losses": lvals})
+        # pod divergence sentinel intake (podview.py, ISSUE 17): these
+        # are already host floats — podview adds no device syncs
+        from imaginaire_tpu.telemetry import podview
+
+        podview.get().note_losses(step, kind, lvals)
         self._update_balance(kind, step, lvals)
 
     def _update_balance(self, kind, step, lvals):

@@ -65,6 +65,16 @@ def chaos_settings(cfg):
                                            0) or 0),
         "stall_duration_s": float(cfg_get(ccfg, "stall_duration_s",
                                           30.0) or 0.0),
+        # divergence injection (ISSUE 17): perturb one process's
+        # OBSERVED loss stream at the podview digest boundary — the
+        # measurable signature of a desynced SPMD replica (an in-graph
+        # perturbation would be homogenized by the healthy pod's
+        # cross-host all-reduce before the loss scalar exists)
+        "diverge_loss_at_step": step("diverge_loss_at_step"),
+        "diverge_process_index": int(
+            cfg_get(ccfg, "diverge_process_index", 0) or 0),
+        "diverge_scale": float(cfg_get(ccfg, "diverge_scale", 1e-3)
+                               or 1e-3),
     }
 
 
@@ -200,6 +210,21 @@ class ChaosMonkey:
                         step):
             corrupt_checkpoint_bytes(path)
 
+    def maybe_perturb_losses(self, losses, step):
+        """Diverge-one-of-N: return a perturbed copy of THIS process's
+        observed loss scalars when its index matches (ISSUE 17). The
+        podview divergence sentinel must trip on the resulting crc
+        mismatch within ``digest_every_n_steps`` steps."""
+        at = self.settings["diverge_loss_at_step"]
+        if at is None or self._my_process_index() \
+                != self.settings["diverge_process_index"]:
+            return losses
+        if not self._should("diverge_loss", at, step):
+            return losses
+        scale = self.settings["diverge_scale"]
+        return {k: float(v) * (1.0 + scale) + scale
+                for k, v in (losses or {}).items()}
+
     def maybe_io_error(self, site):
         """Raise a one-shot ``ChaosIOError`` on the configured site's
         Nth access (sites count their own calls — loader/flow-store
@@ -235,6 +260,9 @@ class _NullChaos:
 
     def maybe_corrupt_checkpoint(self, path, step):
         pass
+
+    def maybe_perturb_losses(self, losses, step):
+        return losses
 
     def maybe_io_error(self, site):
         pass

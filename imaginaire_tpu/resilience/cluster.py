@@ -248,6 +248,18 @@ def timed_barrier(name, timeout_s=None, tag=None):
             f"One process is dead or stalled — every host should exit "
             f"and the supervisor restart the pod.",
             absent=absent, barrier=name) from e
+    # collective-wait attribution (ISSUE 17): the arrival records give
+    # it for free — this process's wait is the spread between its own
+    # arrival stamp and the last one. Read BEFORE deleting our key.
+    try:
+        times = _arrival_times(c, bid)
+        if i in times and times:
+            wait_ms = (max(times.values()) - times[i]) * 1e3
+            from imaginaire_tpu.telemetry import podview
+
+            podview.get().note_collective_wait(wait_ms)
+    except Exception:  # noqa: BLE001 — attribution is best-effort
+        pass
     # rendezvous done on every process: each cleans its own arrival key
     try:
         c.key_value_delete(f"arrive/{bid}/p{i}")
@@ -256,16 +268,22 @@ def timed_barrier(name, timeout_s=None, tag=None):
 
 
 def _arrivals(c, bid):
+    return sorted(_arrival_times(c, bid))
+
+
+def _arrival_times(c, bid):
+    """{process_index: arrival wall time} from the barrier's arrival
+    records."""
     try:
         entries = c.key_value_dir_get(f"arrive/{bid}/")
     except Exception:  # noqa: BLE001
-        return []
-    out = []
-    for key, _ in entries:
+        return {}
+    out = {}
+    for key, value in entries:
         base = key.rsplit("/", 1)[-1]
         if base.startswith("p"):
             try:
-                out.append(int(base[1:]))
+                out[int(base[1:])] = float(value)
             except ValueError:
                 continue
     return out
@@ -281,6 +299,16 @@ def _desync_event(bid, absent, arrived, timeout_s, error):
                 timeout_s=timeout_s, process=process_index(),
                 error=error[:300])
         tm.counter("resilience/cluster_desyncs", 1)
+        # straggler attribution (ISSUE 17) BEFORE the flush: the absent
+        # process(es) get pod/straggler/* counters + the "stalled" span
+        # meta in the same desync flush, so the evidence lands before
+        # ClusterDesyncError unwinds the run
+        try:
+            from imaginaire_tpu.telemetry import podview
+
+            podview.get().note_desync(absent)
+        except Exception:  # noqa: BLE001 — attribution is best-effort
+            pass
         tm.flush()  # the evidence must land before the process exits
     logger.error("cluster barrier %s timed out (%.1fs): absent %s, "
                  "arrived %s", bid, timeout_s, absent, sorted(arrived))

@@ -553,6 +553,19 @@ class Telemetry:
         except Exception:  # noqa: BLE001 — liveness is best-effort
             return None, []
 
+    @staticmethod
+    def _pod_skew_line():
+        """Pod-skew header line (ISSUE 17): every peer's last digest
+        step + wall age from the podview plane, next to the heartbeat
+        line — a hung-pod stack dump should name the step laggard, not
+        just the heartbeat laggard."""
+        try:
+            from imaginaire_tpu.telemetry import podview
+
+            return podview.get().status_line()
+        except Exception:  # noqa: BLE001 — best-effort
+            return None
+
     def dump_stacks(self, reason):
         """Dump every Python thread's stack to the sinks and stderr —
         the watchdog's payload, also callable on demand. The header
@@ -566,12 +579,15 @@ class Telemetry:
             stacks[name] = traceback.format_stack(frame)
         proc = self._process_identity()
         liveness, stalled = self._cluster_liveness()
+        pod_line = self._pod_skew_line()
         event = {"kind": "hang", "t": time.time(), "reason": reason,
                  "step": self.last_step, "process": proc,
                  "stacks": stacks}
         if liveness is not None:
             event["peer_heartbeats"] = liveness
             event["stalled_processes"] = stalled
+        if pod_line is not None:
+            event["pod_skew"] = pod_line
         with self._lock:
             self._events.append(event)
         lines = [f"=== telemetry hang dump [{proc}]: {reason} "
@@ -580,6 +596,8 @@ class Telemetry:
             lines.append(liveness)
             if stalled:
                 lines.append(f"!! likely stalled process(es): {stalled}")
+        if pod_line is not None:
+            lines.append(pod_line)
         for name, frames in stacks.items():
             lines.append(f"--- thread {name} ---")
             lines.extend(f.rstrip("\n") for f in frames)
@@ -683,6 +701,15 @@ def configure(cfg=None, logdir=None, **overrides):
         xla_obs.on_telemetry_configured(cfg, _TELEMETRY)
     except Exception as e:  # noqa: BLE001 — observability is best-effort
         logger.warning("xla_obs configure failed: %s", e)
+    # pod observability plane (podview.py, ISSUE 17) rides it too:
+    # cross-host digest exchange + straggler/divergence sentinels,
+    # active exactly when the cluster layer is
+    try:
+        from imaginaire_tpu.telemetry import podview
+
+        podview.on_telemetry_configured(cfg, _TELEMETRY)
+    except Exception as e:  # noqa: BLE001 — observability is best-effort
+        logger.warning("podview configure failed: %s", e)
     if not _ATEXIT_REGISTERED:
         atexit.register(lambda: _TELEMETRY.shutdown())
         _ATEXIT_REGISTERED = True

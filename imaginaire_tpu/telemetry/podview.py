@@ -1,0 +1,792 @@
+"""Pod observability plane (ISSUE 17): cross-host digest exchange, live
+straggler attribution, and an SPMD divergence sentinel.
+
+Every observability layer before this one saw exactly one process:
+``check_run_health --hosts`` gates each ``telemetry.jsonl.p<i>``
+independently and nothing ever correlates them, so the pod had no
+answer to "which host is slow, in which span, and are the replicas even
+still training the same weights". veScale (PAPERS.md, arXiv:2509.07003)
+frames SPMD consistency as a property to *check*, not assume — this
+repo has already shipped two bugs of exactly that class (the
+N-unsynced-replicas fallback, the epoch-boundary desync), both found
+post-mortem — and arXiv:1810.11112 shows that attributing wall time to
+compute vs communication vs straggler wait per rank is what makes
+multi-host scaling numbers actionable.
+
+Two halves:
+
+**Live plane** — every ``digest_every_n_steps`` steps each process
+publishes a compact digest over the PR-8 coordination KV store
+(piggybacking the ``ClusterHeartbeat`` epoch-scoped keyspace:
+``pod/p<i>`` for a never-resized pod, ``pod/e<E>/p<i>`` after an
+elastic resize): step index, wall timestamp, step-time p50, per-span
+milliseconds since the previous digest (``data_wait`` / ``dis_step`` /
+``gen_step`` / ``collective`` — the collective share comes for free
+from the PR-8 timed barriers' arrival-timestamp spreads), and a crc32
+of the per-step loss scalars the health monitor already ``device_get``s
+at its audit cadence (no new per-step fences). Each process then reads
+every peer's digest history and aggregates at the newest step ALL
+peers have published:
+
+- ``pod/step_skew_ms``    — wall-clock spread across hosts at that step;
+- ``pod/straggler/<p>``   — rounds process ``p`` arrived last (the
+  persistently-slowest host is the one with the largest share), with a
+  ``pod/straggler`` meta naming it and its *dominant span* (largest
+  excess over the pod median);
+- ``pod/divergence``      — the sentinel. Under pure data-parallel fp32
+  meshes SPMD loss scalars must be bit-identical across hosts, so any
+  crc disagreement means the pod is no longer running one program.
+  ``mp``/bf16 configs downgrade to an EWMA relative-delta threshold on
+  the digest's loss magnitude instead of exact crc equality.
+
+A host that stops publishing digests while its peers advance (the
+stall-one-of-N failure mode) is attributed with span ``"stalled"`` —
+either live (digest wall-age past ``stale_after_s``) or from the timed
+-barrier timeout path (``note_desync``), which lands the attribution in
+the telemetry stream BEFORE ``ClusterDesyncError`` unwinds the run.
+
+**Post-hoc plane** — ``merge_pod_timeline(logdir)`` joins every
+``telemetry.jsonl.p<i>`` stream into one clock-aligned pod timeline
+(per-host lanes from the locally-mirrored ``pod/digest`` meta events,
+a per-step skew histogram, and a span-level straggler table), rendered
+by ``scripts/telemetry_report.py --pod`` and gated by the new
+``check_run_health --hosts`` flags ``--max-step-skew-ms`` /
+``--max-divergence`` / ``--max-straggler-share``.
+
+Everything here is best-effort: podview failures degrade to logged
+warnings, never into the training loop.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import re
+import threading
+import time
+import zlib
+from collections import deque
+
+from imaginaire_tpu.config import cfg_get
+
+logger = logging.getLogger(__name__)
+
+# the spans a digest attributes step wall-time to; "collective" is fed
+# by the timed-barrier arrival spreads, the rest by the telemetry phase
+# accumulators
+_DIGEST_SPANS = ("data_wait", "dis_step", "gen_step")
+
+# per-step skew histogram bucket upper edges (ms); the last bucket is
+# open-ended
+_SKEW_BUCKETS_MS = (1.0, 5.0, 20.0, 100.0, 500.0, 2000.0)
+
+
+def pod_settings(cfg):
+    """Parse ``cfg.telemetry.pod`` into PodView settings.
+
+    ``divergence`` mode ``auto`` resolves to ``crc`` (bit-identity) only
+    when the config is a pure data-parallel fp32 run — a model-parallel
+    mesh axis or a non-fp32 compute dtype downgrades to the ``ewma``
+    relative-delta sentinel, because per-host loss scalars are then not
+    guaranteed bit-identical by SPMD alone.
+    """
+    tcfg = cfg_get(cfg or {}, "telemetry", None) or {}
+    pcfg = cfg_get(tcfg, "pod", None) or {}
+    mode = str(cfg_get(pcfg, "divergence", "auto")).lower()
+    if mode == "auto":
+        dtype = str(cfg_get(cfg_get(cfg or {}, "trainer", None) or {},
+                            "compute_dtype", "float32")).lower()
+        model_dim = 1
+        shape = cfg_get(cfg_get(cfg or {}, "parallel", None) or {},
+                        "mesh_shape", None)
+        if isinstance(shape, dict):
+            model_dim = int(cfg_get(shape, "model", 1) or 1)
+        elif isinstance(shape, (list, tuple)) and len(shape) > 1:
+            try:
+                model_dim = int(shape[1])
+            except (TypeError, ValueError):
+                model_dim = 1
+        mode = "crc" if dtype in ("float32", "fp32") and model_dim <= 1 \
+            else "ewma"
+    stale = cfg_get(pcfg, "stale_after_s", None)
+    if stale is None:
+        from imaginaire_tpu.resilience import cluster
+
+        stale = cluster.cluster_settings(cfg)["heartbeat_timeout_s"]
+    return {
+        "enabled": cfg_get(pcfg, "enabled", "auto"),
+        "digest_every_n_steps": max(
+            int(cfg_get(pcfg, "digest_every_n_steps", 10) or 0), 1),
+        "history": max(int(cfg_get(pcfg, "history", 8) or 1), 2),
+        "divergence": mode,  # crc | ewma | off
+        "ewma_rel_threshold": float(
+            cfg_get(pcfg, "ewma_rel_threshold", 0.05) or 0.05),
+        "stale_after_s": float(stale or 0.0),
+    }
+
+
+def podview_key(process_idx, epoch=None):
+    """The KV key this process's digest history publishes under —
+    epoch-scoped for resized pods, flat for epoch 0, mirroring
+    ``cluster.heartbeat_key`` so a departed host's final digests never
+    pollute a later membership's view."""
+    from imaginaire_tpu.resilience import cluster
+
+    e = cluster.membership_epoch() if epoch is None else int(epoch)
+    if e == 0:
+        return f"pod/p{process_idx}"
+    return f"pod/e{e}/p{process_idx}"
+
+
+def _scoped_digests(entries, epoch):
+    """{process_index: [digest, ...]} from ``pod/`` dir entries, scoped
+    to the current membership epoch (same parsing contract as
+    ``cluster.peer_status``)."""
+    out = {}
+    for key, value in entries:
+        parts = [p for p in key.split("/") if p]
+        if "pod" in parts:
+            parts = parts[parts.index("pod") + 1:]
+        if epoch == 0:
+            if len(parts) != 1:
+                continue
+        elif len(parts) != 2 or parts[0] != f"e{epoch}":
+            continue
+        base = parts[-1]
+        if not base.startswith("p"):
+            continue
+        try:
+            idx = int(base[1:])
+            hist = json.loads(value)
+        except ValueError:
+            continue
+        if isinstance(hist, list):
+            out[idx] = [d for d in hist if isinstance(d, dict)]
+    return out
+
+
+class _NullPodView:
+    """Inert default: single-process runs and disabled configs pay one
+    attribute check per hook."""
+
+    enabled = False
+
+    def on_step(self, step):
+        pass
+
+    def note_losses(self, step, kind, losses):
+        pass
+
+    def note_collective_wait(self, wait_ms):
+        pass
+
+    def note_desync(self, absent):
+        pass
+
+    def status_line(self):
+        return None
+
+
+class PodView:
+    """The live half: digest publish + cross-host aggregation. One
+    instance per process, installed by ``configure`` alongside the
+    telemetry singleton."""
+
+    enabled = True
+
+    def __init__(self, settings):
+        self.settings = settings
+        self._lock = threading.Lock()
+        # loss scalars accumulated since the last digest, fed by the
+        # health monitor's audit-cadence ingest (host floats already —
+        # podview adds no device syncs of its own)
+        self._loss_acc = deque(maxlen=256)
+        self._collective_ms = 0.0
+        self._span_snapshot = {}
+        self._history = deque(maxlen=settings["history"])
+        self._rounds = 0
+        self._straggler_rounds = {}
+        self._divergence_count = 0
+        self._checked_steps = set()
+        self._ewma = {}
+        self._peer_status = {}
+        self._desync_noted = set()
+
+    # ------------------------------------------------------------ intake
+
+    def note_losses(self, step, kind, losses):
+        """Accumulate one audited step's host-side loss floats (called
+        by the health monitor with one-step lag). The chaos harness's
+        divergence injection perturbs the OBSERVED stream here — the
+        measurable signature of a desynced replica — since a healthy
+        pod's cross-host all-reduce would homogenize any in-graph
+        perturbation before the loss scalar exists."""
+        from imaginaire_tpu.resilience import chaos
+
+        losses = chaos.get().maybe_perturb_losses(losses, step)
+        items = tuple(sorted((str(k), float(v))
+                             for k, v in (losses or {}).items()))
+        with self._lock:
+            self._loss_acc.append((int(step), str(kind), items))
+
+    def note_collective_wait(self, wait_ms):
+        """Accumulate this process's wait at one timed barrier (last
+        arrival timestamp minus ours — the PR-8 arrival records give
+        collective-wait attribution for free)."""
+        try:
+            wait_ms = float(wait_ms)
+        except (TypeError, ValueError):
+            return
+        if wait_ms > 0:
+            with self._lock:
+                self._collective_ms += wait_ms
+
+    # ----------------------------------------------------------- publish
+
+    def on_step(self, step):
+        """Trainer hook (rides ``step_complete``): publish + aggregate
+        at the digest cadence. Never raises into the step loop."""
+        if step is None or step % self.settings["digest_every_n_steps"]:
+            return
+        try:
+            digest = self._publish(int(step))
+            if digest is not None:
+                self._aggregate(digest)
+        except Exception as e:  # noqa: BLE001 — observability only
+            logger.warning("podview digest at step %s failed: %s", step, e)
+
+    def _span_round_ms(self, tm):
+        """Per-span milliseconds since the previous digest: the diff of
+        the telemetry phase totals, plus the accumulated collective
+        wait."""
+        with tm._lock:
+            totals = {name: phase[1]
+                      for name, phase in tm._phases.items()}
+        with self._lock:
+            spans = {}
+            for name in _DIGEST_SPANS:
+                now_s = totals.get(name, 0.0)
+                prev_s = self._span_snapshot.get(name, 0.0)
+                spans[name] = round(max(now_s - prev_s, 0.0) * 1e3, 3)
+            self._span_snapshot = totals
+            spans["collective"] = round(self._collective_ms, 3)
+            self._collective_ms = 0.0
+        return spans
+
+    def _loss_window(self):
+        """(crc32, mean) over the loss scalars accumulated since the
+        previous digest, or (None, None) when diagnostics are off."""
+        with self._lock:
+            acc, = [list(self._loss_acc)]
+            self._loss_acc.clear()
+        if not acc:
+            return None, None
+        parts = []
+        values = []
+        for step, kind, items in sorted(acc):
+            for name, value in items:
+                # repr of a float is exact: bit-identical replicas
+                # produce byte-identical digests
+                parts.append(f"{step}:{kind}:{name}={value!r}")
+                values.append(value)
+        crc = zlib.crc32(";".join(parts).encode())
+        mean = sum(values) / len(values) if values else 0.0
+        return int(crc), mean
+
+    def _publish(self, step):
+        from imaginaire_tpu import telemetry
+        from imaginaire_tpu.resilience import cluster
+
+        c = cluster.client()
+        if c is None:
+            return None
+        tm = telemetry.get()
+        ring = list(tm._ring)
+        p50 = tm._percentile(ring, 0.50)
+        crc, loss_val = self._loss_window()
+        digest = {
+            "step": step,
+            "t": round(time.time(), 3),
+            "step_ms_p50": round(p50 * 1e3, 3) if p50 is not None
+            else None,
+            "spans": self._span_round_ms(tm),
+            "loss_crc": crc,
+            "loss_val": loss_val,
+        }
+        self._history.append(digest)
+        i = cluster.process_index()
+        try:
+            c.key_value_set(podview_key(i),
+                            json.dumps(list(self._history)),
+                            allow_overwrite=True)
+        except Exception as e:  # noqa: BLE001 — publish best-effort
+            logger.warning("podview publish failed: %s", e)
+        # local mirror: the post-hoc merge (and the tests' synthetic
+        # fixtures) parse pod/digest metas straight out of the jsonl
+        tm.meta("pod/digest", **digest)
+        return digest
+
+    # --------------------------------------------------------- aggregate
+
+    def _read_peers(self):
+        from imaginaire_tpu.resilience import cluster
+
+        c = cluster.client()
+        if c is None:
+            return None
+        try:
+            entries = c.key_value_dir_get("pod/")
+        except Exception:  # noqa: BLE001 — nobody published yet
+            entries = []
+        return _scoped_digests(entries, cluster.membership_epoch())
+
+    def _aggregate(self, my_digest):
+        """Cross-host view at the newest step every peer has published.
+        Every process aggregates (and emits the counters into its OWN
+        jsonl — the --hosts gate reads per-process files); the math is
+        deterministic over the same KV contents, so the pod agrees on
+        the verdicts without another rendezvous."""
+        from imaginaire_tpu import telemetry
+        from imaginaire_tpu.resilience import cluster
+
+        hists = self._read_peers()
+        if not hists:
+            return
+        tm = telemetry.get()
+        n = cluster.process_count()
+        now = time.time()
+        step = my_digest["step"]
+        with self._lock:
+            self._peer_status = {
+                p: {"step": hist[-1].get("step"),
+                    "t": hist[-1].get("t"),
+                    "age_s": round(now - float(hist[-1].get("t") or 0),
+                                   1)}
+                for p, hist in hists.items() if hist}
+        # live staleness: a peer that stopped digesting while we
+        # advance is a straggler with no span left to blame — it
+        # stopped making step progress entirely
+        stale_after = self.settings["stale_after_s"]
+        for p in range(n):
+            hist = hists.get(p)
+            last_t = float(hist[-1].get("t") or 0) if hist else 0.0
+            if stale_after > 0 and now - last_t > stale_after:
+                self._name_straggler(
+                    tm, p, "stalled", step=step,
+                    last_step=(hist[-1].get("step") if hist else None),
+                    age_s=round(now - last_t, 1) if hist else None)
+        # newest step present in EVERY peer's history
+        common = None
+        by_step = {}
+        for p, hist in hists.items():
+            by_step[p] = {d.get("step"): d for d in hist}
+        if len(hists) == n:
+            shared = set.intersection(*(set(s.keys())
+                                        for s in by_step.values()))
+            shared.discard(None)
+            common = max(shared) if shared else None
+        if common is not None:
+            recs = {p: by_step[p][common] for p in by_step}
+            times = {p: float(d.get("t") or 0) for p, d in recs.items()}
+            skew_ms = (max(times.values()) - min(times.values())) * 1e3
+            tm.counter("pod/step_skew_ms", round(skew_ms, 3), step=step)
+            slowest = max(times, key=times.get)
+            with self._lock:
+                self._rounds += 1
+                self._straggler_rounds[slowest] = \
+                    self._straggler_rounds.get(slowest, 0) + 1
+                rounds = dict(self._straggler_rounds)
+                total = self._rounds
+            for p, count in sorted(rounds.items()):
+                tm.counter(f"pod/straggler/p{p}", count, step=step)
+            leader = max(rounds, key=rounds.get)
+            if leader in recs:
+                span = self._dominant_span(recs, leader)
+                tm.meta("pod/straggler", step=common, process=leader,
+                        span=span, rounds=rounds[leader],
+                        share=round(rounds[leader] / total, 3),
+                        skew_ms=round(skew_ms, 3))
+            self._check_divergence(tm, by_step, n, step)
+        # the sentinel counter is emitted every round — "0 divergences
+        # observed" must be distinguishable from "sentinel never ran"
+        tm.counter("pod/divergence", self._divergence_count, step=step)
+
+    @staticmethod
+    def _dominant_span(recs, process):
+        """The straggler's span with the largest excess over the pod
+        median — data_wait vs dis/gen_step vs collective."""
+        mine = recs[process].get("spans") or {}
+        best, best_excess = "step", 0.0
+        for name in tuple(_DIGEST_SPANS) + ("collective",):
+            samples = sorted(
+                float((d.get("spans") or {}).get(name, 0.0) or 0.0)
+                for d in recs.values())
+            if not samples:
+                continue
+            median = samples[len(samples) // 2]
+            excess = float(mine.get(name, 0.0) or 0.0) - median
+            if excess > best_excess:
+                best, best_excess = name, excess
+        return best
+
+    def _check_divergence(self, tm, by_step, n, step):
+        """The SPMD divergence sentinel over every not-yet-checked step
+        all peers have published. ``crc`` mode (pure-dp fp32): the loss
+        scalar is an all-reduced replicated value, so any crc mismatch
+        means the hosts are NOT running one SPMD program — the
+        historical N-unsynced-replicas / epoch-desync bug class.
+        ``ewma`` mode (mp/bf16): per-host relative delta of the digest
+        loss magnitude vs the pod median, EWMA-smoothed, thresholded."""
+        mode = self.settings["divergence"]
+        if mode == "off" or len(by_step) < n:
+            return
+        shared = set.intersection(*(set(s.keys())
+                                    for s in by_step.values()))
+        shared.discard(None)
+        for s in sorted(shared):
+            if s in self._checked_steps:
+                continue
+            self._checked_steps.add(s)
+            recs = {p: by_step[p][s] for p in by_step}
+            if mode == "crc":
+                crcs = {p: d.get("loss_crc") for p, d in recs.items()}
+                seen = {v for v in crcs.values() if v is not None}
+                if len(seen) > 1:
+                    self._divergence_count += 1
+                    tm.meta("pod/divergence", step=s, mode="crc",
+                            crcs={f"p{p}": v
+                                  for p, v in sorted(crcs.items())})
+                    logger.error(
+                        "podview: SPMD divergence at step %s — loss "
+                        "crcs disagree across hosts (%s); the replicas "
+                        "are no longer training the same weights", s,
+                        crcs)
+            else:
+                vals = {p: d.get("loss_val") for p, d in recs.items()
+                        if d.get("loss_val") is not None}
+                if len(vals) < 2:
+                    continue
+                ordered = sorted(vals.values())
+                median = ordered[len(ordered) // 2]
+                denom = max(abs(median), 1e-12)
+                threshold = self.settings["ewma_rel_threshold"]
+                for p, v in sorted(vals.items()):
+                    rel = abs(v - median) / denom
+                    ewma = self._ewma.get(p)
+                    ewma = rel if ewma is None else 0.5 * ewma + 0.5 * rel
+                    self._ewma[p] = ewma
+                    if ewma > threshold:
+                        self._divergence_count += 1
+                        tm.meta("pod/divergence", step=s, mode="ewma",
+                                process=p, rel_delta=round(rel, 6),
+                                ewma=round(ewma, 6),
+                                threshold=threshold)
+                        logger.error(
+                            "podview: loss divergence at step %s — "
+                            "p%d relative delta EWMA %.4g over "
+                            "threshold %g", s, p, ewma, threshold)
+
+    def _name_straggler(self, tm, process, span, step=None,
+                        last_step=None, age_s=None, reason=None):
+        with self._lock:
+            self._straggler_rounds[process] = \
+                self._straggler_rounds.get(process, 0) + 1
+            count = self._straggler_rounds[process]
+        tm.counter(f"pod/straggler/p{process}", count, step=step)
+        tm.meta("pod/straggler", step=step, process=process, span=span,
+                rounds=count, last_step=last_step, age_s=age_s,
+                reason=reason or "digest_stale")
+
+    # ------------------------------------------------------ stall paths
+
+    def note_desync(self, absent):
+        """Timed-barrier timeout hook (``cluster._desync_event``): the
+        absent process(es) stopped mid-step — no span of theirs ever
+        finished, so the attribution is span ``"stalled"``. Runs before
+        the desync's telemetry flush, so ``pod/straggler/*`` lands in
+        the jsonl BEFORE ``ClusterDesyncError`` unwinds the run."""
+        from imaginaire_tpu import telemetry
+
+        tm = telemetry.get()
+        if not tm.enabled:
+            return
+        now = time.time()
+        for p in sorted(set(int(a) for a in (absent or ()))):
+            if p in self._desync_noted:
+                continue
+            self._desync_noted.add(p)
+            status = self._peer_status.get(p) or {}
+            age = status.get("t")
+            self._name_straggler(
+                tm, p, "stalled", step=tm.last_step,
+                last_step=status.get("step"),
+                age_s=round(now - float(age), 1) if age else None,
+                reason="absent_at_barrier")
+
+    # --------------------------------------------------------- watchdog
+
+    def status_line(self):
+        """One header line for the hang dump: every peer's last digest
+        step + wall age, so a hung-pod stack dump names the laggard
+        without a separate report run."""
+        with self._lock:
+            status = dict(self._peer_status)
+        if not status:
+            return None
+        parts = []
+        for p, rec in sorted(status.items()):
+            parts.append(f"p{p}: step {rec.get('step')} "
+                         f"({rec.get('age_s')}s ago)")
+        steps = [rec.get("step") for rec in status.values()
+                 if rec.get("step") is not None]
+        skew = f"; step spread {max(steps) - min(steps)}" \
+            if len(steps) > 1 else ""
+        return "pod digests: " + "; ".join(parts) + skew
+
+
+# -------------------------------------------------- module-level singleton
+
+_PODVIEW = _NullPodView()
+
+
+def get():
+    """The process podview singleton (inert until ``configure``)."""
+    return _PODVIEW
+
+
+def configure(settings):
+    """Install the podview singleton from parsed settings (see
+    ``pod_settings``); anything falsy installs the inert null object."""
+    global _PODVIEW
+    if settings and settings.get("enabled"):
+        _PODVIEW = PodView(settings)
+    else:
+        _PODVIEW = _NullPodView()
+    return _PODVIEW
+
+
+def on_telemetry_configured(cfg, tm):
+    """Rides ``telemetry.configure`` (like ``xla_obs``): resolve the
+    ``enabled: auto`` knob against the live topology — podview needs a
+    coordination-service KV client, which exists exactly when the
+    cluster layer is active."""
+    from imaginaire_tpu.resilience import cluster
+
+    settings = pod_settings(cfg)
+    if settings["enabled"] == "auto":
+        settings["enabled"] = bool(tm.enabled) and cluster.is_active()
+    else:
+        settings["enabled"] = bool(settings["enabled"]) \
+            and bool(tm.enabled) and cluster.client() is not None
+    return configure(settings)
+
+
+# ------------------------------------------------------ post-hoc plane
+
+def _host_files(path):
+    """[(process_index_or_None, path)] for a run dir's telemetry files
+    (same contract as ``check_run_health.host_files``, reimplemented
+    here so the package never imports from scripts/)."""
+    if os.path.isfile(path):
+        base, dirname = os.path.basename(path), os.path.dirname(path)
+        m = re.match(r"(telemetry\.jsonl)(\.p\d+)?$", base)
+        root = os.path.join(dirname, m.group(1)) if m else path
+    else:
+        root = os.path.join(path, "telemetry.jsonl")
+    out = []
+    if os.path.exists(root):
+        out.append((None, root))
+    for f in glob.glob(root + ".p*"):
+        m = re.search(r"\.p(\d+)$", f)
+        if m:
+            out.append((int(m.group(1)), f))
+    out.sort(key=lambda kv: (-1 if kv[0] is None else kv[0]))
+    return out
+
+
+def merge_pod_timeline(logdir):
+    """Join all per-process telemetry streams of a run into one
+    clock-aligned pod timeline.
+
+    Returns ``{hosts, files, steps, skew, straggler, divergence}``:
+
+    - ``steps``: per digest step, each host's wall timestamp + spans,
+      the skew (ms) across hosts, and the slowest host;
+    - ``skew``: p50/max over all fully-populated steps plus a bucketed
+      histogram (``le_<ms>``/``gt_<ms>`` counts);
+    - ``straggler``: per-host slowest-round counts, per-host per-span
+      totals, and the persistent leader with its dominant span;
+    - ``divergence``: post-hoc sentinel re-run over the merged digests
+      (crc comparison per step) plus the live counters' verdict.
+
+    Wall timestamps come from each host's own clock; on a localhost pod
+    they share one clock, on a real pod NTP-level alignment is assumed
+    (the same assumption the heartbeat staleness checks already make).
+    """
+    from imaginaire_tpu.telemetry.report import load_events
+
+    files = _host_files(logdir)
+    digests = {}
+    live_divergence = {}
+    span_totals = {}
+    for proc, fpath in files:
+        p = -1 if proc is None else proc
+        for ev in load_events(fpath):
+            if ev.get("kind") == "meta" and ev.get("name") == "pod/digest":
+                digests.setdefault(p, {})[ev.get("step")] = ev
+            elif ev.get("kind") == "counter" \
+                    and ev.get("name") == "pod/divergence":
+                live_divergence[p] = int(ev.get("value") or 0)
+    # per-host span totals from the digests themselves (not raw span
+    # events): the digest spans already attribute collective-wait,
+    # which no local span ever carries
+    for p, by in digests.items():
+        for d in by.values():
+            for name, ms in (d.get("spans") or {}).items():
+                span_totals.setdefault(p, {})
+                span_totals[p][name] = span_totals[p].get(name, 0.0) \
+                    + float(ms or 0.0)
+    hosts = sorted(digests)
+    steps = {}
+    skews = []
+    hist = {f"le_{int(b)}ms": 0 for b in _SKEW_BUCKETS_MS}
+    hist[f"gt_{int(_SKEW_BUCKETS_MS[-1])}ms"] = 0
+    slowest_rounds = {}
+    divergence_steps = []
+    all_steps = sorted({s for d in digests.values() for s in d
+                        if s is not None})
+    for s in all_steps:
+        recs = {p: digests[p][s] for p in hosts if s in digests[p]}
+        lanes = {p: {"t": recs[p].get("t"),
+                     "spans": recs[p].get("spans"),
+                     "loss_crc": recs[p].get("loss_crc")}
+                 for p in recs}
+        entry = {"hosts": lanes}
+        if len(recs) > 1:
+            times = [float(r.get("t") or 0) for r in recs.values()]
+            skew_ms = (max(times) - min(times)) * 1e3
+            entry["skew_ms"] = round(skew_ms, 3)
+            slowest = max(recs, key=lambda p: float(recs[p].get("t")
+                                                    or 0))
+            entry["slowest"] = slowest
+            if len(recs) == len(hosts):
+                skews.append(skew_ms)
+                slowest_rounds[slowest] = \
+                    slowest_rounds.get(slowest, 0) + 1
+                for edge in _SKEW_BUCKETS_MS:
+                    if skew_ms <= edge:
+                        hist[f"le_{int(edge)}ms"] += 1
+                        break
+                else:
+                    hist[f"gt_{int(_SKEW_BUCKETS_MS[-1])}ms"] += 1
+            crcs = {p: r.get("loss_crc") for p, r in recs.items()}
+            seen = {v for v in crcs.values() if v is not None}
+            if len(seen) > 1:
+                entry["diverged"] = True
+                divergence_steps.append(s)
+        steps[s] = entry
+    skew = {"rounds": len(skews), "hist": hist}
+    if skews:
+        ordered = sorted(skews)
+        skew["p50_ms"] = round(
+            ordered[min(int(0.5 * (len(ordered) - 1) + 0.5),
+                        len(ordered) - 1)], 3)
+        skew["max_ms"] = round(ordered[-1], 3)
+    straggler = {"rounds": slowest_rounds, "spans": span_totals}
+    if slowest_rounds:
+        leader = max(slowest_rounds, key=slowest_rounds.get)
+        straggler["process"] = leader
+        straggler["share"] = round(
+            slowest_rounds[leader] / max(sum(slowest_rounds.values()),
+                                         1), 3)
+        mine = span_totals.get(leader) or {}
+        best, best_excess = None, 0.0
+        for name in tuple(_DIGEST_SPANS) + ("collective",):
+            samples = sorted(
+                float((span_totals.get(p) or {}).get(name, 0.0))
+                for p in hosts)
+            if not samples:
+                continue
+            median = samples[len(samples) // 2]
+            excess = float(mine.get(name, 0.0)) - median
+            if excess > best_excess:
+                best, best_excess = name, excess
+        straggler["span"] = best
+    divergence = {
+        "count": max([len(divergence_steps)]
+                     + list(live_divergence.values())),
+        "steps": divergence_steps,
+        "live_counters": {f"p{p}": v
+                          for p, v in sorted(live_divergence.items())},
+    }
+    return {
+        "hosts": hosts,
+        "files": {(-1 if p is None else p): f for p, f in files},
+        "steps": steps,
+        "skew": skew,
+        "straggler": straggler,
+        "divergence": divergence,
+    }
+
+
+def render_pod_timeline(merged):
+    """Markdown rendering of a merged pod timeline (the
+    ``telemetry_report.py --pod`` payload): per-host lanes, the skew
+    histogram, and the span-level straggler table."""
+    lines = ["# pod timeline",
+             f"hosts: {len(merged['hosts'])} "
+             f"({', '.join('p%d' % p for p in merged['hosts'])})"]
+    skew = merged.get("skew") or {}
+    if skew.get("rounds"):
+        lines.append(f"step skew: p50 {skew.get('p50_ms')}ms, max "
+                     f"{skew.get('max_ms')}ms over {skew['rounds']} "
+                     f"fully-populated digest round(s)")
+        hist = ", ".join(f"{k}: {v}" for k, v in skew["hist"].items()
+                         if v)
+        if hist:
+            lines.append(f"skew histogram: {hist}")
+    straggler = merged.get("straggler") or {}
+    if straggler.get("process") is not None:
+        lines.append(
+            f"straggler: p{straggler['process']} (slowest in "
+            f"{straggler['share'] * 100:.0f}% of rounds, dominant span "
+            f"{straggler.get('span') or 'n/a'})")
+    div = merged.get("divergence") or {}
+    if div.get("count"):
+        lines.append(f"!! divergence: {div['count']} event(s)"
+                     + (f" at step(s) {div['steps'][:8]}"
+                        if div.get("steps") else ""))
+    else:
+        lines.append("divergence: 0")
+    lines.append("")
+    lines.append("| step | skew ms | slowest | " + " | ".join(
+        f"p{p} t" for p in merged["hosts"]) + " |")
+    lines.append("|---" * (3 + len(merged["hosts"])) + "|")
+    for s in sorted(merged.get("steps") or {}):
+        entry = merged["steps"][s]
+        lanes = entry.get("hosts") or {}
+        t_cells = []
+        for p in merged["hosts"]:
+            rec = lanes.get(p)
+            t_cells.append(f"{rec['t']:.3f}" if rec and rec.get("t")
+                           else "-")
+        slowest = entry.get("slowest")
+        lines.append(
+            f"| {s} | {entry.get('skew_ms', '-')} | "
+            f"{('p%d' % slowest) if slowest is not None else '-'}"
+            f"{' !!' if entry.get('diverged') else ''} | "
+            + " | ".join(t_cells) + " |")
+    spans = straggler.get("spans") or {}
+    if spans:
+        names = sorted({n for per in spans.values() for n in per})
+        lines.append("")
+        lines.append("per-host span totals (ms):")
+        lines.append("| host | " + " | ".join(names) + " |")
+        lines.append("|---" * (1 + len(names)) + "|")
+        for p in sorted(spans):
+            row = spans[p]
+            lines.append(f"| p{p} | " + " | ".join(
+                f"{row.get(n, 0.0):.1f}" for n in names) + " |")
+    return "\n".join(lines)

@@ -60,6 +60,10 @@ def summarize(events):
     resize_events = []
     remap_events = []
     graph_events = []
+    pod_skew_series = []
+    pod_straggler_events = []
+    pod_divergence_events = []
+    pod_digest_count = 0
     meta = {}
     hangs = []
     t_min = t_max = None
@@ -86,6 +90,11 @@ def summarize(events):
             elif str(ev["name"]).startswith("flow_cache/"):
                 flow_cache_series.setdefault(ev["name"], []).append(
                     float(ev.get("value") or 0.0))
+            elif ev["name"] == "pod/step_skew_ms":
+                # full series: the gate thresholds the p50, not the
+                # latest value
+                pod_skew_series.append(
+                    [ev.get("step"), float(ev.get("value") or 0.0)])
         elif kind == "meta":
             name = ev.get("name")
             if name == "nonfinite":
@@ -121,6 +130,12 @@ def summarize(events):
                 remap_events.append(ev)
             elif name == "graph_violation":
                 graph_events.append(ev)
+            elif name == "pod/digest":
+                pod_digest_count += 1
+            elif name == "pod/straggler":
+                pod_straggler_events.append(ev)
+            elif name == "pod/divergence":
+                pod_divergence_events.append(ev)
             elif str(name).startswith("chaos/"):
                 chaos_events.append(ev)
             meta[ev.get("name", "?")] = ev
@@ -272,10 +287,48 @@ def summarize(events):
                                 for p in graph_programs.values()),
         "violation_events": graph_events,
     }
+    # pod observability plane (ISSUE 17): cross-host step skew, the
+    # persistent-straggler attribution, and the SPMD divergence
+    # sentinel — check_run_health --hosts gates on skew p50 /
+    # divergence count / straggler share
+    straggler_counters = {}
+    for name, (value, _) in counters.items():
+        m = str(name)
+        if m.startswith("pod/straggler/"):
+            straggler_counters[m[len("pod/straggler/"):]] = \
+                int(value or 0)
+    skew_vals = [v for _, v in pod_skew_series]
+    pod = {
+        "present": bool(pod_skew_series or pod_digest_count
+                        or "pod/divergence" in counters),
+        "digest_count": pod_digest_count,
+        "skew_series": pod_skew_series,
+        "step_skew_ms_p50": _percentile(skew_vals, 0.50)
+        if skew_vals else None,
+        "step_skew_ms_max": max(skew_vals) if skew_vals else None,
+        "divergence_count": int(
+            counters.get("pod/divergence", (0, None))[0] or 0)
+        or len(pod_divergence_events),
+        "divergence_events": pod_divergence_events,
+        "straggler_counters": straggler_counters,
+        "straggler_events": pod_straggler_events,
+    }
+    if straggler_counters:
+        total = sum(straggler_counters.values())
+        leader = max(straggler_counters, key=straggler_counters.get)
+        span = next((ev.get("span")
+                     for ev in reversed(pod_straggler_events)
+                     if f"p{ev.get('process')}" == leader), None)
+        pod["straggler"] = {
+            "process": leader,
+            "rounds": straggler_counters[leader],
+            "share": straggler_counters[leader] / max(total, 1),
+            "span": span,
+        }
     return {"phases": table, "counters": counters, "meta": meta,
             "hangs": hangs, "wall_s": wall_s, "health": health,
             "flow_cache": flow_cache, "xla": xla,
-            "resilience": resilience, "graph": graph}
+            "resilience": resilience, "graph": graph, "pod": pod}
 
 
 def _trend(series):
@@ -507,6 +560,45 @@ def _elasticity_section(s):
     return lines
 
 
+def _pod_section(s):
+    """Markdown lines for the pod observability section (ISSUE 17):
+    cross-host step skew, straggler attribution, and the divergence
+    sentinel's verdict. Empty when the run published no pod digests
+    (single-process)."""
+    p = s.get("pod") or {}
+    if not p.get("present"):
+        return []
+    lines = ["", "## pod"]
+    lines.append(f"- digests published: {p.get('digest_count', 0)}")
+    if p.get("step_skew_ms_p50") is not None:
+        lines.append(
+            f"- step skew: p50 {p['step_skew_ms_p50']:.1f}ms, max "
+            f"{p['step_skew_ms_max']:.1f}ms over "
+            f"{len(p.get('skew_series') or [])} round(s)")
+    straggler = p.get("straggler")
+    if straggler:
+        lines.append(
+            f"- straggler: {straggler['process']} (slowest in "
+            f"{straggler['rounds']} round(s), "
+            f"{straggler['share'] * 100:.0f}% share, dominant span "
+            f"{straggler.get('span') or 'n/a'})")
+    div = p.get("divergence_count", 0)
+    if div:
+        lines.append(f"- !! divergence sentinel: {div} event(s)")
+        for ev in p.get("divergence_events", [])[:5]:
+            if ev.get("mode") == "crc":
+                lines.append(f"  - step {ev.get('step')}: loss crcs "
+                             f"disagree ({ev.get('crcs')})")
+            else:
+                lines.append(
+                    f"  - step {ev.get('step')}: p{ev.get('process')} "
+                    f"rel delta EWMA {ev.get('ewma')} over "
+                    f"{ev.get('threshold')}")
+    else:
+        lines.append("- divergence sentinel: 0 events")
+    return lines
+
+
 def render_report(path_or_events):
     """Markdown-ish report (the PROFILE.md table format) for a
     telemetry.jsonl path or a pre-loaded event list."""
@@ -554,6 +646,7 @@ def render_report(path_or_events):
     lines.extend(_graph_section(s))
     lines.extend(_resilience_section(s))
     lines.extend(_elasticity_section(s))
+    lines.extend(_pod_section(s))
     if s["hangs"]:
         lines.append("")
         lines.append(f"!! {len(s['hangs'])} hang dump(s) recorded:")

@@ -28,6 +28,29 @@ import os
 
 logger = logging.getLogger(__name__)
 
+# warn-once latch for the no-logdir fallback below
+_WARNED_NO_LOGDIR = False
+
+
+def _fallback_logdir():
+    """Run-dir fallback for ``make_sinks`` calls without a logdir: a
+    bare-cwd ``./telemetry.jsonl`` silently litters whatever directory
+    the entry point happened to launch from and is invisible to
+    ``check_run_health``/``telemetry_report`` pointed at the run dir —
+    route to a dated dir under the ``logs/`` root instead (the same
+    convention ``init_logging`` uses) and warn once."""
+    global _WARNED_NO_LOGDIR
+    from imaginaire_tpu.utils.logging_utils import get_date_uid
+
+    path = os.path.join("logs", f"{get_date_uid()}_telemetry")
+    if not _WARNED_NO_LOGDIR:
+        _WARNED_NO_LOGDIR = True
+        logger.warning(
+            "telemetry.configure called without a logdir — refusing the "
+            "bare-cwd telemetry.jsonl write, routing to %s/ instead",
+            path)
+    return path
+
 
 class Sink:
     """Base sink: ``emit`` receives one event dict, ``flush`` commits."""
@@ -158,13 +181,15 @@ def make_sinks(names, logdir=None):
     Unknown names warn and are skipped (a config typo should not kill a
     training run). On multi-process runs the JSONL path is suffixed per
     process so hosts never clobber each other's event streams; console
-    output stays master-only.
+    output stays master-only. Without a logdir the JSONL sink refuses
+    the bare-cwd write and routes to a dated ``logs/`` dir (warns once).
     """
     sinks = []
     for name in names or ():
         name = str(name).lower()
         if name == "jsonl":
-            path = os.path.join(logdir or ".", "telemetry.jsonl")
+            path = os.path.join(logdir or _fallback_logdir(),
+                                "telemetry.jsonl")
             try:
                 import jax
 

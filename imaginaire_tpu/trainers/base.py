@@ -40,6 +40,7 @@ import optax
 
 from imaginaire_tpu import telemetry
 from imaginaire_tpu.config import as_attrdict, cfg_get
+from imaginaire_tpu.telemetry import podview
 from imaginaire_tpu.optim import (
     get_optimizer_for_params,
     get_scheduler,
@@ -604,6 +605,9 @@ class BaseTrainer:
                 dur_s=self.time_iteration,
                 # lint: allow(host-sync) -- heartbeat fence, runs only at the telemetry flush interval
                 fence=lambda: jax.block_until_ready(self.state))
+            # pod digest (podview.py, ISSUE 17): publish/aggregate at
+            # the digest cadence; inert null object single-process
+            podview.get().on_step(current_iteration)
         cfg = self.cfg
         if current_iteration % cfg_get(cfg, "logging_iter", 100) == 0:
             self._meter("time/iteration").write(self.time_iteration)

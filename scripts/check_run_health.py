@@ -17,7 +17,12 @@ Checks (each can fail the gate):
   without audit counters (audit disabled, old logs) pass unchanged;
 - ``--require-health``: the run must actually carry ``health/*``
   counters (guards against a config that silently disabled diagnostics
-  — a green gate over a blind run is worse than a red one).
+  — a green gate over a blind run is worse than a red one);
+- pod observability (ISSUE 17): step-skew p50 beyond
+  ``--max-step-skew-ms``, SPMD divergence sentinel events beyond
+  ``--max-divergence`` (pass 0 — fp32 data-parallel replicas must stay
+  bit-identical), and a persistent straggler's slowest-round share
+  beyond ``--max-straggler-share``. Runs without pod counters pass.
 
 Multi-host pods (ISSUE 8): every process writes its own
 ``telemetry.jsonl.p<i>`` — ``--hosts`` aggregates ALL per-process files
@@ -53,7 +58,9 @@ def check_health(summary, require_health=False, max_dg_breaches=0,
                  max_recompiles=0, mem_budget_frac=None,
                  max_fallbacks=0, max_temp_frac=None,
                  max_graph_violations=0,
-                 max_resizes=None, min_world_size=None):
+                 max_resizes=None, min_world_size=None,
+                 max_step_skew_ms=None, max_divergence=None,
+                 max_straggler_share=None):
     """Return the list of failure strings for an aggregated summary."""
     failures = []
     health = summary.get("health") or {}
@@ -197,6 +204,37 @@ def check_health(summary, require_health=False, max_dg_breaches=0,
             failures.append(
                 f"pod resized below --min-world-size {min_world_size}: "
                 f"{shapes[:4]}")
+    # pod observability gates (ISSUE 17): skew p50 / divergence count /
+    # straggler share from the podview digest plane. Only runs that
+    # carried pod counters are gated — single-process runs and old
+    # logs pass unchanged (the graph-gate idiom).
+    pod = summary.get("pod") or {}
+    if pod.get("present"):
+        skew_p50 = pod.get("step_skew_ms_p50")
+        if max_step_skew_ms is not None and skew_p50 is not None \
+                and skew_p50 > max_step_skew_ms:
+            failures.append(
+                f"pod step skew p50 {skew_p50:.1f}ms exceeds "
+                f"--max-step-skew-ms {max_step_skew_ms:g} "
+                f"(max {pod.get('step_skew_ms_max'):.1f}ms)")
+        div = pod.get("divergence_count", 0)
+        if max_divergence is not None and div > max_divergence:
+            steps = [e.get("step") for e
+                     in pod.get("divergence_events", [])]
+            failures.append(
+                f"{div} SPMD divergence event(s) (allowed "
+                f"{max_divergence})"
+                + (f": step(s) {steps[:4]}" if steps else "")
+                + " — the replicas are not training the same weights")
+        straggler = pod.get("straggler") or {}
+        share = straggler.get("share")
+        if max_straggler_share is not None and share is not None \
+                and share > max_straggler_share:
+            failures.append(
+                f"persistent straggler {straggler.get('process')} "
+                f"(slowest in {share:.0%} of rounds, span "
+                f"{straggler.get('span') or 'n/a'}) exceeds "
+                f"--max-straggler-share {max_straggler_share:g}")
     if require_health and not health.get("has_health_counters"):
         failures.append(
             "no health/* counters in the run (diagnostics disabled or "
@@ -269,6 +307,21 @@ def main(argv=None):
                     help="fail when any elastic resize landed below "
                          "this world size (reads elastic/resize meta "
                          "events; default: no floor)")
+    ap.add_argument("--max-step-skew-ms", type=float, default=None,
+                    help="fail when the pod step-skew p50 "
+                         "(pod/step_skew_ms counters) exceeds this "
+                         "(default: no skew gate; runs without pod "
+                         "counters pass)")
+    ap.add_argument("--max-divergence", type=int, default=None,
+                    help="tolerated SPMD divergence sentinel events "
+                         "(pod/divergence counter; pass 0 to fail on "
+                         "any — fp32 data-parallel replicas must stay "
+                         "bit-identical. Default: no divergence gate)")
+    ap.add_argument("--max-straggler-share", type=float, default=None,
+                    help="fail when one process is the slowest in more "
+                         "than this fraction of digest rounds "
+                         "(pod/straggler/* counters; default: no "
+                         "straggler gate)")
     ap.add_argument("--hosts", action="store_true",
                     help="aggregate every per-process telemetry file "
                          "(telemetry.jsonl + telemetry.jsonl.p*) of a "
@@ -299,7 +352,10 @@ def main(argv=None):
                             max_temp_frac=args.max_temp_frac,
                             max_graph_violations=args.max_graph_violations,
                             max_resizes=args.max_resizes,
-                            min_world_size=args.min_world_size)
+                            min_world_size=args.min_world_size,
+                            max_step_skew_ms=args.max_step_skew_ms,
+                            max_divergence=args.max_divergence,
+                            max_straggler_share=args.max_straggler_share)
     health = summary.get("health") or {}
     xla = summary.get("xla") or {}
     res = summary.get("resilience") or {}
@@ -341,6 +397,15 @@ def main(argv=None):
                 "elastic_resizes": res.get("elastic_resizes", 0),
                 "resize_downtime_ms": res.get("resize_downtime_ms"),
             },
+            "pod": {
+                "present": (summary.get("pod") or {}).get("present",
+                                                          False),
+                "step_skew_ms_p50": (summary.get("pod") or {}).get(
+                    "step_skew_ms_p50"),
+                "divergence_count": (summary.get("pod") or {}).get(
+                    "divergence_count", 0),
+                "straggler": (summary.get("pod") or {}).get("straggler"),
+            },
         }, indent=1, default=str))
     elif failures:
         for failure in failures:
@@ -380,7 +445,11 @@ def _main_hosts(args):
                                 max_graph_violations=
                                 args.max_graph_violations,
                                 max_resizes=args.max_resizes,
-                                min_world_size=args.min_world_size)
+                                min_world_size=args.min_world_size,
+                                max_step_skew_ms=args.max_step_skew_ms,
+                                max_divergence=args.max_divergence,
+                                max_straggler_share=
+                                args.max_straggler_share)
         verdicts[label] = {"path": fpath, "healthy": not failures,
                            "failures": failures}
         any_fail = any_fail or bool(failures)

@@ -12,6 +12,13 @@ Usage:
     python scripts/telemetry_report.py logs/<run>/telemetry.jsonl
     python scripts/telemetry_report.py logs/<run>            # dir works too
     python scripts/telemetry_report.py <path> --json         # machine-readable
+    python scripts/telemetry_report.py logs/<run> --pod      # pod timeline
+
+``--pod`` (ISSUE 17) merges every per-process ``telemetry.jsonl.p<i>``
+of the run into one clock-aligned pod timeline — per-host lanes,
+per-step skew histogram, span-level straggler table — instead of the
+single-file phase report; with ``--json`` it dumps the merged
+structure.
 
 The MFU shown is reproducible from the JSONL alone: the ``step_flops``
 meta event records the XLA cost analysis (and the peak-FLOPs source),
@@ -44,8 +51,28 @@ def main():
     ap.add_argument("--json", action="store_true",
                     help="dump the aggregated summary as JSON instead "
                          "of the table")
+    ap.add_argument("--pod", action="store_true",
+                    help="merge all per-process telemetry files into "
+                         "one clock-aligned pod timeline (per-host "
+                         "lanes, skew histogram, straggler table)")
     args = ap.parse_args()
     path = args.path
+    if args.pod:
+        from imaginaire_tpu.telemetry.podview import (
+            merge_pod_timeline,
+            render_pod_timeline,
+        )
+
+        merged = merge_pod_timeline(path)
+        if not merged["hosts"]:
+            raise SystemExit(f"no pod/digest events under {path} — "
+                             f"was the run multi-process with "
+                             f"telemetry.pod enabled?")
+        if args.json:
+            print(json.dumps(merged, indent=1, default=str))
+        else:
+            print(render_pod_timeline(merged))
+        return
     if os.path.isdir(path):
         path = os.path.join(path, "telemetry.jsonl")
     if not os.path.exists(path):

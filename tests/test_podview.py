@@ -1,0 +1,602 @@
+"""Pod observability plane (ISSUE 17): digest publish/aggregate over
+the coordination KV, cross-host skew math, the SPMD divergence
+sentinel, straggler attribution (live-slow, stale, and desync paths),
+the merged pod timeline, and the new check_run_health gates.
+
+Like test_cluster.py, the live plane runs against the in-memory fake of
+the jax coordination-service KV client
+(``cluster.set_client_for_testing``) — two "processes" are simulated by
+switching the fake topology's process index between publishes against
+one shared KV dict. The dryrun ``spade_pod`` leg covers the real-pod
+end-to-end path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from imaginaire_tpu import telemetry
+from imaginaire_tpu.resilience import chaos, cluster
+from imaginaire_tpu.telemetry import podview
+from imaginaire_tpu.telemetry.report import summarize
+
+
+class FakeClient:
+    """In-memory stand-in for jaxlib's DistributedRuntimeClient KV
+    surface (the PR-8 test seam; barrier untested here)."""
+
+    def __init__(self, n):
+        self.n = n
+        self.kv = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        if key in self.kv and not allow_overwrite:
+            raise RuntimeError(f"key exists: {key}")
+        self.kv[key] = value
+
+    def key_value_dir_get(self, prefix):
+        return sorted((k, v) for k, v in self.kv.items()
+                      if k.startswith(prefix))
+
+    def key_value_delete(self, key):
+        self.kv.pop(key, None)
+
+    def wait_at_barrier(self, barrier_id, timeout_ms, process_ids=None):
+        pass
+
+
+SETTINGS = {
+    "enabled": True,
+    "digest_every_n_steps": 1,
+    "history": 8,
+    "divergence": "crc",
+    "ewma_rel_threshold": 0.05,
+    "stale_after_s": 0.0,
+}
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    cluster.set_client_for_testing(None)
+    cluster._SETTINGS = None
+    podview.configure(None)
+    chaos._CHAOS = chaos._NULL
+
+
+@pytest.fixture
+def tm():
+    t = telemetry.configure(cfg=None, enabled=True, sinks=[],
+                            flush_every_n_steps=0, mfu=False)
+    # configure(cfg=None) auto-installs a null podview; tests install
+    # their own explicitly
+    yield t
+
+
+def _events(tm, kind=None, name=None):
+    with tm._lock:
+        evs = list(tm._events)
+    return [e for e in evs
+            if (kind is None or e.get("kind") == kind)
+            and (name is None or e.get("name") == name)]
+
+
+def _publish_as(client, proc, n, settings=None, losses=None, step=1,
+                view=None):
+    """Publish one digest as process ``proc`` against the shared KV;
+    returns the PodView used (pass ``view`` to keep one across steps)."""
+    cluster.set_client_for_testing(client, process_index=proc,
+                                   process_count=n)
+    if view is None:
+        view = podview.PodView(dict(settings or SETTINGS))
+    podview._PODVIEW = view
+    if losses is not None:
+        view.note_losses(step, "G", losses)
+    view.on_step(step)
+    return view
+
+
+# ------------------------------------------------- publish / aggregate
+
+
+class TestDigestPublish:
+    def test_publish_writes_epoch_scoped_key_and_local_meta(self, tm):
+        client = FakeClient(2)
+        _publish_as(client, 0, 2, losses={"total": 1.0})
+        assert "pod/p0" in client.kv
+        hist = json.loads(client.kv["pod/p0"])
+        assert isinstance(hist, list) and hist[-1]["step"] == 1
+        assert hist[-1]["loss_crc"] is not None
+        assert "spans" in hist[-1] and "collective" in hist[-1]["spans"]
+        # the digest is mirrored into the local jsonl stream — the
+        # post-hoc merge's parse target
+        metas = _events(tm, "meta", "pod/digest")
+        assert len(metas) == 1 and metas[0]["step"] == 1
+
+    def test_digest_cadence(self, tm):
+        client = FakeClient(1)
+        settings = dict(SETTINGS, digest_every_n_steps=5)
+        cluster.set_client_for_testing(client, process_index=0,
+                                       process_count=1)
+        view = podview.PodView(settings)
+        podview._PODVIEW = view
+        for step in range(1, 11):
+            view.on_step(step)
+        hist = json.loads(client.kv["pod/p0"])
+        assert [d["step"] for d in hist] == [5, 10]
+
+    def test_history_bounded(self, tm):
+        client = FakeClient(1)
+        settings = dict(SETTINGS, history=3)
+        cluster.set_client_for_testing(client, process_index=0,
+                                       process_count=1)
+        view = podview.PodView(settings)
+        podview._PODVIEW = view
+        for step in range(1, 6):
+            view.on_step(step)
+        hist = json.loads(client.kv["pod/p0"])
+        assert [d["step"] for d in hist] == [3, 4, 5]
+
+    def test_every_process_emits_counters(self, tm):
+        # the --hosts gate reads per-process files: BOTH processes must
+        # emit skew/divergence counters into their own streams once the
+        # pod is fully published
+        client = FakeClient(2)
+        _publish_as(client, 1, 2, losses={"total": 1.0})
+        _publish_as(client, 0, 2, losses={"total": 1.0})
+        # p0 (published last, sees both) has the full set
+        assert _events(tm, "counter", "pod/step_skew_ms")
+        assert _events(tm, "counter", "pod/divergence")
+        assert _events(tm, "meta", "pod/straggler")
+
+    def test_aggregate_uses_newest_common_step(self, tm):
+        # peers at different digest phases: the skew round runs at the
+        # newest step BOTH have published, not the global newest
+        client = FakeClient(2)
+        now = time.time()
+        client.kv["pod/p1"] = json.dumps([
+            {"step": 1, "t": now - 0.5, "spans": {}, "loss_crc": 1,
+             "loss_val": 1.0},
+            {"step": 2, "t": now - 0.2, "spans": {}, "loss_crc": 1,
+             "loss_val": 1.0},
+        ])
+        view = _publish_as(client, 0, 2, losses={"total": 1.0}, step=2)
+        skews = _events(tm, "counter", "pod/step_skew_ms")
+        assert len(skews) == 1 and skews[0]["step"] == 2
+        # only steps BOTH hosts published are divergence-checkable
+        assert view._checked_steps == {2}
+
+
+class TestSkewMath:
+    def test_skew_vs_hand_computed_timeline(self, tm):
+        # p1's digest for step 1 is stamped 250ms before ours -> the
+        # skew at the common step is ~250ms and p0 (later t) is slowest
+        client = FakeClient(2)
+        cluster.set_client_for_testing(client, process_index=0,
+                                       process_count=2)
+        view = podview.PodView(dict(SETTINGS))
+        podview._PODVIEW = view
+        client.kv["pod/p1"] = json.dumps([
+            {"step": 1, "t": time.time() - 0.25, "spans": {},
+             "loss_crc": None, "loss_val": None}])
+        view.on_step(1)
+        skew = _events(tm, "counter", "pod/step_skew_ms")[0]
+        assert skew["value"] == pytest.approx(250.0, abs=100.0)
+        straggler = _events(tm, "meta", "pod/straggler")[0]
+        assert straggler["process"] == 0
+        assert _events(tm, "counter", "pod/straggler/p0")
+
+    def test_dominant_span_is_largest_excess_over_median(self):
+        recs = {
+            0: {"spans": {"data_wait": 5.0, "dis_step": 10.0,
+                          "gen_step": 10.0, "collective": 1.0}},
+            1: {"spans": {"data_wait": 90.0, "dis_step": 12.0,
+                          "gen_step": 11.0, "collective": 2.0}},
+            2: {"spans": {"data_wait": 6.0, "dis_step": 11.0,
+                          "gen_step": 10.0, "collective": 1.0}},
+        }
+        assert podview.PodView._dominant_span(recs, 1) == "data_wait"
+
+    def test_collective_wait_accumulates_into_digest(self, tm):
+        client = FakeClient(2)
+        cluster.set_client_for_testing(client, process_index=0,
+                                       process_count=2)
+        view = podview.PodView(dict(SETTINGS))
+        podview._PODVIEW = view
+        view.note_collective_wait(12.5)
+        view.note_collective_wait(7.5)
+        view.on_step(1)
+        hist = json.loads(client.kv["pod/p0"])
+        assert hist[-1]["spans"]["collective"] == pytest.approx(20.0)
+        # and the accumulator resets for the next digest window
+        view.on_step(2)
+        hist = json.loads(client.kv["pod/p0"])
+        assert hist[-1]["spans"]["collective"] == 0.0
+
+    def test_timed_barrier_feeds_collective_wait(self, tm):
+        # the PR-8 arrival spreads feed podview for free: a barrier
+        # where the peer arrived earlier credits our wait as ~0, a
+        # barrier where the peer arrives later credits the spread
+        client = FakeClient(2)
+        cluster.set_client_for_testing(client, process_index=0,
+                                       process_count=2)
+        view = podview.PodView(dict(SETTINGS))
+        podview._PODVIEW = view
+        # peer arrived 40ms after us: our key is written by
+        # timed_barrier itself; pre-plant the peer's late arrival
+        client.kv["arrive/sync:t0/p1"] = f"{time.time() + 0.04:.3f}"
+        cluster.timed_barrier("sync", timeout_s=5, tag="t0")
+        assert view._collective_ms == pytest.approx(40.0, abs=30.0)
+
+
+# --------------------------------------------------- divergence sentinel
+
+
+class TestDivergenceSentinel:
+    def test_silent_on_bit_identical_runs(self, tm):
+        client = FakeClient(2)
+        _publish_as(client, 1, 2, losses={"total": 1.2345678901234567})
+        _publish_as(client, 0, 2, losses={"total": 1.2345678901234567})
+        assert not _events(tm, "meta", "pod/divergence")
+        counters = _events(tm, "counter", "pod/divergence")
+        assert counters and all(c["value"] == 0 for c in counters)
+
+    def test_fires_on_flipped_loss_crc(self, tm):
+        client = FakeClient(2)
+        _publish_as(client, 1, 2, losses={"total": 1.0000001})
+        _publish_as(client, 0, 2, losses={"total": 1.0})
+        metas = _events(tm, "meta", "pod/divergence")
+        assert len(metas) == 1 and metas[0]["mode"] == "crc"
+        assert metas[0]["crcs"]["p0"] != metas[0]["crcs"]["p1"]
+        counters = _events(tm, "counter", "pod/divergence")
+        assert counters[-1]["value"] == 1
+
+    def test_each_step_checked_once(self, tm):
+        # re-aggregating the same histories must not double-count
+        client = FakeClient(2)
+        _publish_as(client, 1, 2, losses={"total": 2.0})
+        view = _publish_as(client, 0, 2, losses={"total": 1.0})
+        view._aggregate(view._history[-1])
+        counters = _events(tm, "counter", "pod/divergence")
+        assert counters[-1]["value"] == 1
+
+    def test_chaos_injection_trips_crc(self, tm):
+        # the drill path: chaos perturbs ONE process's observed losses
+        # at the digest boundary, the sentinel must notice
+        chaos._CHAOS = chaos.ChaosMonkey(chaos.chaos_settings({
+            "chaos": {"enabled": True, "diverge_loss_at_step": 1,
+                      "diverge_process_index": 1}}))
+        client = FakeClient(2)
+        _publish_as(client, 1, 2, losses={"total": 1.0})
+        _publish_as(client, 0, 2, losses={"total": 1.0})
+        metas = _events(tm, "meta", "pod/divergence")
+        assert len(metas) == 1 and metas[0]["mode"] == "crc"
+
+    def test_ewma_mode_thresholds_relative_delta(self, tm):
+        client = FakeClient(2)
+        settings = dict(SETTINGS, divergence="ewma",
+                        ewma_rel_threshold=0.05)
+        _publish_as(client, 1, 2, settings=settings,
+                    losses={"total": 1.0})
+        _publish_as(client, 0, 2, settings=settings,
+                    losses={"total": 1.5})
+        metas = _events(tm, "meta", "pod/divergence")
+        assert metas and metas[0]["mode"] == "ewma"
+
+    def test_ewma_mode_tolerates_small_deltas(self, tm):
+        client = FakeClient(2)
+        settings = dict(SETTINGS, divergence="ewma",
+                        ewma_rel_threshold=0.05)
+        _publish_as(client, 1, 2, settings=settings,
+                    losses={"total": 1.0})
+        _publish_as(client, 0, 2, settings=settings,
+                    losses={"total": 1.01})
+        assert not _events(tm, "meta", "pod/divergence")
+
+
+class TestDivergenceModeAuto:
+    def test_fp32_pure_dp_resolves_to_crc(self):
+        s = podview.pod_settings({
+            "trainer": {"compute_dtype": "float32"},
+            "parallel": {"mesh_shape": None}})
+        assert s["divergence"] == "crc"
+
+    def test_bf16_downgrades_to_ewma(self):
+        s = podview.pod_settings({
+            "trainer": {"compute_dtype": "bfloat16"}})
+        assert s["divergence"] == "ewma"
+
+    def test_model_parallel_downgrades_to_ewma(self):
+        s = podview.pod_settings({
+            "trainer": {"compute_dtype": "float32"},
+            "parallel": {"mesh_shape": {"data": 2, "model": 2}}})
+        assert s["divergence"] == "ewma"
+
+    def test_explicit_mode_wins(self):
+        s = podview.pod_settings({
+            "telemetry": {"pod": {"divergence": "crc"}},
+            "trainer": {"compute_dtype": "bfloat16"}})
+        assert s["divergence"] == "crc"
+
+
+# ----------------------------------------------- straggler attribution
+
+
+class TestStragglerAttribution:
+    def test_stale_peer_attributed_with_stalled_span(self, tm):
+        client = FakeClient(2)
+        cluster.set_client_for_testing(client, process_index=0,
+                                       process_count=2)
+        settings = dict(SETTINGS, stale_after_s=5.0)
+        view = podview.PodView(settings)
+        podview._PODVIEW = view
+        # p1's last digest is 60s old — it stopped making step progress
+        client.kv["pod/p1"] = json.dumps([
+            {"step": 3, "t": time.time() - 60.0, "spans": {},
+             "loss_crc": None, "loss_val": None}])
+        view.on_step(9)
+        metas = _events(tm, "meta", "pod/straggler")
+        stalled = [m for m in metas if m["process"] == 1]
+        assert stalled and stalled[0]["span"] == "stalled"
+        assert stalled[0]["last_step"] == 3
+        assert _events(tm, "counter", "pod/straggler/p1")
+
+    def test_note_desync_lands_before_flush(self, tm):
+        # the barrier-timeout path: attribution must be in the stream
+        # (and idempotent per process) before ClusterDesyncError raises
+        client = FakeClient(2)
+        cluster.set_client_for_testing(client, process_index=0,
+                                       process_count=2)
+        view = podview.PodView(dict(SETTINGS))
+        podview._PODVIEW = view
+        view.note_desync([1])
+        view.note_desync([1])  # second desync event: same process
+        metas = _events(tm, "meta", "pod/straggler")
+        assert len(metas) == 1
+        assert metas[0]["process"] == 1
+        assert metas[0]["span"] == "stalled"
+        assert metas[0]["reason"] == "absent_at_barrier"
+
+    def test_status_line_names_laggard(self, tm):
+        client = FakeClient(2)
+        _publish_as(client, 1, 2)
+        view = _publish_as(client, 0, 2)
+        line = view.status_line()
+        assert line is not None and "p0" in line and "p1" in line
+        # and it rides the hang-dump header via the telemetry hook
+        assert telemetry.Telemetry._pod_skew_line() == line
+
+
+# ------------------------------------------------------ post-hoc plane
+
+
+def _write_host_jsonl(logdir, proc, digests, extra=()):
+    path = os.path.join(logdir, f"telemetry.jsonl.p{proc}")
+    with open(path, "w") as f:
+        for d in digests:
+            f.write(json.dumps({"kind": "meta", "name": "pod/digest",
+                                "t": d["t"], **d}) + "\n")
+        for ev in extra:
+            f.write(json.dumps(ev) + "\n")
+    return path
+
+
+def _three_host_fixture(tmp_path, diverge_at=None):
+    """Synthetic 3-host pod: p2 is persistently ~100ms late with a fat
+    data_wait span; optional crc flip on p1 at ``diverge_at``."""
+    t0 = 1_700_000_000.0
+    for proc in range(3):
+        digests = []
+        for step in (1, 2, 3):
+            late = 0.1 if proc == 2 else 0.0
+            crc = 1111
+            if diverge_at is not None and proc == 1 \
+                    and step >= diverge_at:
+                crc = 2222
+            digests.append({
+                "step": step,
+                "t": t0 + step * 1.0 + late,
+                "spans": {"data_wait": 120.0 if proc == 2 else 20.0,
+                          "dis_step": 30.0, "gen_step": 40.0,
+                          "collective": 5.0},
+                "loss_crc": crc, "loss_val": 1.0,
+            })
+        _write_host_jsonl(str(tmp_path), proc, digests)
+    return str(tmp_path)
+
+
+class TestMergePodTimeline:
+    def test_merges_lanes_and_skew(self, tmp_path):
+        logdir = _three_host_fixture(tmp_path)
+        merged = podview.merge_pod_timeline(logdir)
+        assert merged["hosts"] == [0, 1, 2]
+        assert set(merged["steps"]) == {1, 2, 3}
+        for s in (1, 2, 3):
+            entry = merged["steps"][s]
+            assert entry["slowest"] == 2
+            assert entry["skew_ms"] == pytest.approx(100.0)
+        assert merged["skew"]["p50_ms"] == pytest.approx(100.0)
+        assert merged["skew"]["rounds"] == 3
+        # 100ms lands in the le_100ms bucket
+        assert merged["skew"]["hist"]["le_100ms"] == 3
+
+    def test_straggler_table_names_span(self, tmp_path):
+        logdir = _three_host_fixture(tmp_path)
+        merged = podview.merge_pod_timeline(logdir)
+        assert merged["straggler"]["process"] == 2
+        assert merged["straggler"]["share"] == 1.0
+        assert merged["straggler"]["span"] == "data_wait"
+        assert merged["divergence"]["count"] == 0
+
+    def test_divergence_detected_post_hoc(self, tmp_path):
+        logdir = _three_host_fixture(tmp_path, diverge_at=2)
+        merged = podview.merge_pod_timeline(logdir)
+        assert merged["divergence"]["count"] == 2
+        assert merged["divergence"]["steps"] == [2, 3]
+        assert merged["steps"][2]["diverged"] is True
+
+    def test_render_is_markdown(self, tmp_path):
+        logdir = _three_host_fixture(tmp_path, diverge_at=3)
+        out = podview.render_pod_timeline(
+            podview.merge_pod_timeline(logdir))
+        assert "# pod timeline" in out
+        assert "straggler: p2" in out
+        assert "| step |" in out
+        assert "!! divergence" in out
+
+    def test_tolerates_partial_histories(self, tmp_path):
+        # p1 died after step 1: steps 2-3 still render from the
+        # surviving lanes, skew stats only count full rounds
+        t0 = 1_700_000_000.0
+        _write_host_jsonl(str(tmp_path), 0, [
+            {"step": s, "t": t0 + s, "spans": {}, "loss_crc": 1,
+             "loss_val": 1.0} for s in (1, 2, 3)])
+        _write_host_jsonl(str(tmp_path), 1, [
+            {"step": 1, "t": t0 + 1.05, "spans": {}, "loss_crc": 1,
+             "loss_val": 1.0}])
+        merged = podview.merge_pod_timeline(str(tmp_path))
+        assert set(merged["steps"]) == {1, 2, 3}
+        assert merged["skew"]["rounds"] == 1
+
+
+# ------------------------------------------------------------- gates
+
+
+def _pod_events(skew_values=(), straggler=None, divergence=0,
+                divergence_steps=()):
+    evs = [{"kind": "counter", "name": "pod/step_skew_ms", "value": v,
+            "step": i + 1, "t": 1.0} for i, v in enumerate(skew_values)]
+    if straggler is not None:
+        proc, rounds = straggler
+        evs.append({"kind": "counter",
+                    "name": f"pod/straggler/p{proc}", "value": rounds,
+                    "step": 1, "t": 1.0})
+        evs.append({"kind": "meta", "name": "pod/straggler", "t": 1.0,
+                    "process": proc, "span": "data_wait",
+                    "rounds": rounds})
+    evs.append({"kind": "counter", "name": "pod/divergence",
+                "value": divergence, "step": 1, "t": 1.0})
+    for s in divergence_steps:
+        evs.append({"kind": "meta", "name": "pod/divergence", "t": 1.0,
+                    "step": s, "mode": "crc"})
+    return evs
+
+
+class TestHealthGates:
+    def test_clean_pod_passes_all_gates(self):
+        from scripts.check_run_health import check_health
+
+        summary = summarize(_pod_events(skew_values=[5.0, 8.0]))
+        assert summary["pod"]["present"]
+        failures = check_health(summary, max_step_skew_ms=50,
+                                max_divergence=0,
+                                max_straggler_share=0.9)
+        assert failures == []
+
+    def test_skew_gate_thresholds_p50(self):
+        from scripts.check_run_health import check_health
+
+        summary = summarize(_pod_events(skew_values=[10.0, 900.0,
+                                                     950.0]))
+        failures = check_health(summary, max_step_skew_ms=100)
+        assert len(failures) == 1 and "step skew" in failures[0]
+
+    def test_divergence_gate_zero_tolerance(self):
+        from scripts.check_run_health import check_health
+
+        summary = summarize(_pod_events(divergence=1,
+                                        divergence_steps=[4]))
+        failures = check_health(summary, max_divergence=0)
+        assert len(failures) == 1
+        assert "divergence" in failures[0] and "step(s) [4]" in failures[0]
+
+    def test_straggler_share_gate(self):
+        from scripts.check_run_health import check_health
+
+        summary = summarize(_pod_events(skew_values=[5.0],
+                                        straggler=(2, 9)))
+        failures = check_health(summary, max_straggler_share=0.5)
+        assert len(failures) == 1 and "straggler" in failures[0]
+        assert "p2" in failures[0] and "data_wait" in failures[0]
+
+    def test_runs_without_pod_counters_pass(self):
+        from scripts.check_run_health import check_health
+
+        summary = summarize([])
+        failures = check_health(summary, max_step_skew_ms=1,
+                                max_divergence=0,
+                                max_straggler_share=0.1)
+        assert failures == []
+
+    def test_hosts_cli_gate_fails_on_divergence(self, tmp_path):
+        from scripts.check_run_health import main
+
+        for proc in range(2):
+            path = os.path.join(str(tmp_path),
+                                f"telemetry.jsonl.p{proc}")
+            with open(path, "w") as f:
+                for ev in _pod_events(skew_values=[5.0],
+                                      divergence=1 if proc == 0 else 0,
+                                      divergence_steps=[3]
+                                      if proc == 0 else ()):
+                    f.write(json.dumps(ev) + "\n")
+        assert main([str(tmp_path), "--hosts", "--max-divergence", "0"]
+                    ) == 1
+        assert main([str(tmp_path), "--hosts", "--max-divergence", "1"]
+                    ) == 0
+
+    def test_report_pod_section(self):
+        from imaginaire_tpu.telemetry.report import render_report
+
+        out = render_report(_pod_events(skew_values=[5.0, 8.0],
+                                        straggler=(1, 3),
+                                        divergence=1,
+                                        divergence_steps=[2]))
+        assert "## pod" in out
+        assert "straggler: p1" in out
+        assert "divergence sentinel: 1" in out
+
+
+# ----------------------------------------------------------- satellites
+
+
+class TestChaosDivergenceKnob:
+    def test_perturbs_only_matching_process_and_step(self):
+        monkey = chaos.ChaosMonkey(chaos.chaos_settings({
+            "chaos": {"enabled": True, "diverge_loss_at_step": 3,
+                      "diverge_process_index": 0,
+                      "diverge_scale": 1e-3}}))
+        clean = {"total": 2.0}
+        assert monkey.maybe_perturb_losses(clean, 2) == clean
+        out = monkey.maybe_perturb_losses(clean, 3)
+        assert out["total"] != clean["total"]
+        # one-shot: the same step never fires twice
+        assert monkey.maybe_perturb_losses(clean, 3) == clean
+
+    def test_null_chaos_passthrough(self):
+        losses = {"total": 1.0}
+        assert chaos._NULL.maybe_perturb_losses(losses, 1) is losses
+
+
+class TestSinksLogdirFallback:
+    def test_no_logdir_routes_away_from_cwd(self, monkeypatch):
+        from imaginaire_tpu.telemetry import sinks as sinks_mod
+
+        monkeypatch.setattr(sinks_mod, "_WARNED_NO_LOGDIR", False)
+        built = sinks_mod.make_sinks(["jsonl"], logdir=None)
+        assert len(built) == 1
+        path = built[0].path
+        assert os.path.dirname(path) != ""  # never bare-cwd
+        assert os.path.normpath(path).startswith("logs" + os.sep)
+        assert path.endswith("telemetry.jsonl")
+
+    def test_explicit_logdir_unchanged(self, tmp_path):
+        from imaginaire_tpu.telemetry import sinks as sinks_mod
+
+        built = sinks_mod.make_sinks(["jsonl"], logdir=str(tmp_path))
+        assert built[0].path == os.path.join(str(tmp_path),
+                                             "telemetry.jsonl")
