@@ -70,6 +70,7 @@ def _leg_summary(tm, xla_mark=None, trainer=None):
     out["resilience"] = _resilience_leg()
     out.update(_pipeline_leg(tm))
     out["pod"] = _pod_leg(tm)
+    out["eval"] = _eval_leg(tm)
     return out
 
 
@@ -107,6 +108,38 @@ def _pipeline_leg(tm):
         "pipeline_depth": int(depth) if depth is not None else None,
         "overlap_ratio": latest.get("pipeline/overlap_ratio"),
         "dispatch_gap_ms": latest.get("pipeline/dispatch_gap_ms"),
+    }
+
+
+def _eval_leg(tm):
+    """{fid, time_to_fid_ms, ref_cache_hit_rate} for one bench leg
+    (ISSUE 18) — the quality plane's verdict when the leg ran eval
+    sweeps (latest FID, latest sweep's wall-clock, and the share of
+    sweeps whose reference activations came from the content-addressed
+    store). None for legs that never evaluated."""
+    fid = ttf = None
+    hits = []
+    try:
+        with tm._lock:
+            events = list(tm._events)
+        for ev in events:
+            if ev.get("kind") != "counter":
+                continue
+            name = str(ev.get("name", ""))
+            if name == "eval/fid":
+                fid = ev.get("value")
+            elif name == "eval/time_to_fid_ms":
+                ttf = ev.get("value")
+            elif name == "eval/ref_cache_hit":
+                hits.append(int(ev.get("value") or 0))
+    except Exception:  # noqa: BLE001 — bench accounting is best-effort
+        pass
+    if fid is None and not hits:
+        return None
+    return {
+        "fid": fid,
+        "time_to_fid_ms": ttf,
+        "ref_cache_hit_rate": (sum(hits) / len(hits)) if hits else None,
     }
 
 
@@ -511,6 +544,101 @@ def run_teacher_ab(width="zoo", hw=(256, 512), bs=2, seq_len=4, iters=4):
     _merge_vidbench(payload)
     print(json.dumps({
         "metric": "vid2vid_teacher_cache_speedup_pct",
+        "value": round(speedup_pct, 2),
+        "unit": "pct",
+        "vs_baseline": None,
+    }))
+    return payload
+
+
+def _merge_evalbench(extra):
+    """Merge keys into EVALBENCH.json without clobbering existing rows."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "EVALBENCH.json")
+    book = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            book = json.load(f)
+    book.update(extra)
+    with open(path, "w") as f:
+        json.dump(book, f, indent=1)
+
+
+def run_eval_ab(batches=8, bs=8, hw=(64, 64)):
+    """Reference-store cold-vs-warm A/B (ISSUE 18 acceptance record):
+    the same quality sweep driven twice through the eval plane — cold
+    (reference activations computed and published to the
+    content-addressed store) and warm (reference shard read back) —
+    recording both legs' time-to-FID and the warm speedup into
+    EVALBENCH.json. Runs the patch smoke extractor (the store A/B is
+    about the REFERENCE side's recompute-vs-read, which is
+    extractor-agnostic; inception on CPU would bury the signal under
+    minutes of network forward). Multi-device processes (real chips, or
+    XLA_FLAGS=--xla_force_host_platform_device_count=N on CPU) set the
+    all-device data mesh first, so the sweep's batches genuinely shard
+    — the recorded ``devices`` field says which regime a row measured."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from imaginaire_tpu.evaluation import EvalPlane, make_patch_extractor
+
+    tm = _bench_telemetry()
+    devices = len(jax.devices())
+    if devices > 1:
+        from imaginaire_tpu.parallel.mesh import mesh_from_config, set_mesh
+
+        set_mesh(mesh_from_config({}))
+    rng = np.random.RandomState(0)
+    loader = [{"images": rng.rand(bs, hw[0], hw[1], 3).astype(
+        np.float32) * 2 - 1} for _ in range(batches)]
+
+    def gen_fn(data):
+        return jnp.clip(jnp.asarray(np.asarray(
+            data["images"])) * 0.7 + 0.05, -1.0, 1.0)
+
+    store_dir = tempfile.mkdtemp(prefix="eval_ab_store_")
+    plane = EvalPlane(cfg={"evaluation": {"extractor": "patch"}},
+                      store_dir=store_dir)
+    extractor = make_patch_extractor()
+    # compile outside the timed legs: cold must measure the reference
+    # RECOMPUTE, not XLA compile time
+    np.asarray(extractor(jnp.zeros((bs, 299, 299, 3), jnp.float32)))
+
+    legs = {}
+    for leg, step in (("cold", 1), ("warm", 2)):
+        r = plane.run_sweep(loader, "images", "fake_images", extractor,
+                            gen_fn, step=step, dataset_name="bench_synth",
+                            resolution=f"{hw[0]}x{hw[1]}",
+                            extractor_tag="patch-v1:g8")
+        legs[leg] = {"fid": round(r["fid"], 4),
+                     "time_to_fid_ms": round(r["time_to_fid_ms"], 2),
+                     "ref_cache_hit": r["ref_cache_hit"]}
+    assert legs["warm"]["ref_cache_hit"] and \
+        not legs["cold"]["ref_cache_hit"], \
+        "warm leg missed the reference store (or cold leg hit a stale one)"
+    speedup_pct = (legs["cold"]["time_to_fid_ms"]
+                   / max(legs["warm"]["time_to_fid_ms"], 1e-6)
+                   - 1.0) * 100.0
+    payload = {
+        "time_to_fid_warm_ms": legs["warm"]["time_to_fid_ms"],
+        "eval_ab": {
+            "platform": jax.devices()[0].platform,
+            "devices": devices,
+            "extractor": "patch",
+            "batches": batches,
+            "batch_size": bs,
+            "resolution": f"{hw[0]}x{hw[1]}",
+            "cold": legs["cold"],
+            "warm": legs["warm"],
+            "warm_speedup_pct": round(speedup_pct, 2),
+            "leg": _eval_leg(tm),
+        },
+    }
+    _merge_evalbench(payload)
+    print(json.dumps({
+        "metric": "eval_ref_store_warm_speedup_pct",
         "value": round(speedup_pct, 2),
         "unit": "pct",
         "vs_baseline": None,
@@ -1649,6 +1777,14 @@ def main():
                              "pipelined_ab; --width unit runs the "
                              "CPU-feasible 64x64 smoke, zoo the "
                              "cityscapes recipe")
+    parser.add_argument("--eval-ab", action="store_true",
+                        help="reference-store cold-vs-warm quality-sweep "
+                             "A/B only (ISSUE 18): two identical sweeps "
+                             "through the eval plane, first computing the "
+                             "reference activations, second reading the "
+                             "content-addressed shard back -> "
+                             "EVALBENCH.json eval_ab + "
+                             "time_to_fid_warm_ms")
     parser.add_argument("--pod-scaling", action="store_true",
                         help="run ONLY the pod-scaling legs (ISSUE 14): "
                              "imgs/s + frames/s at 1/2/3 localhost pod "
@@ -1665,6 +1801,9 @@ def main():
         return
     if args.pod_scaling:
         run_pod_scaling()
+        return
+    if args.eval_ab:
+        run_eval_ab()
         return
     if args.pipeline_ab:
         run_pipeline_ab(width=args.width if args.width == "unit" else "zoo")

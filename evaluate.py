@@ -119,7 +119,21 @@ def main():
         print(f"Evaluating {checkpoint} (epoch {trainer.current_epoch}, "
               f"iteration {trainer.current_iteration})")
         if "fid" in metrics:
-            trainer.write_metrics()
+            # ISSUE 18: FID routes through the sharded eval plane —
+            # reference activations via the content-addressed store,
+            # eval/* counters into this run's jsonl (the SAME schema
+            # continuous eval emits, so check_run_health --max-fid
+            # gates offline sweeps too). Trainer families without a
+            # plane-capable generator closure (video rollouts) return
+            # None and fall back to the classic write_metrics path.
+            result = trainer.continuous_eval(trainer.current_iteration,
+                                             metrics=["fid"])
+            if result is None:
+                trainer.write_metrics()
+            else:
+                print(f"  FID: {result['fid']:.5f} "
+                      f"(time_to_fid {result['time_to_fid_ms']:.0f} ms, "
+                      f"ref_cache_hit={result['ref_cache_hit']})")
         extra_requested = [m for m in metrics if m != "fid"]
         extra = trainer.compute_extra_metrics(extra_requested)
         if extra_requested and not extra:

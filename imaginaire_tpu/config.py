@@ -459,6 +459,45 @@ def default_config():
             diverge_loss_at_step=None,
             diverge_process_index=0,
             diverge_scale=1e-3,
+            # quality degradation (ISSUE 18): inflate the measured FID
+            # of every eval sweep from the Nth (1-based) onward by
+            # degrade_eval_scale (relative). Persistent, not one-shot:
+            # the regression sentinel requires K *consecutive* bad
+            # sweeps, so a single degraded point would never trip it —
+            # this models a genuinely regressed model, which stays bad.
+            degrade_eval_at_sweep=None,
+            degrade_eval_scale=1.0,
+        ),
+        # -- quality observability plane (evaluation/plane.py, ISSUE
+        # 18): continuous FID/KID during training. every_n_iter sets
+        # the sweep cadence (None = off, the default — offline
+        # evaluate.py still routes through the same plane); metrics
+        # picks which of fid|kid each sweep computes; max_batches
+        # truncates the sweep's loader walk (rides the reference-store
+        # key, so truncated and full reference sets never mix). store
+        # toggles the content-addressed reference-feature store
+        # (store_dir overrides its <logdir>/feature_store default —
+        # point it at shared storage to share reference activations
+        # across runs/hosts). The regression sentinel fires when a
+        # sweep's FID is regression_threshold (relative) worse than the
+        # EWMA baseline (ewma_beta) for regression_consecutive sweeps
+        # in a row — `check_run_health --max-quality-regressions`
+        # gates on the resulting eval/regressions counter.
+        # extractor inception|patch: patch swaps the Inception network
+        # for mean-pooled pixel patches — CI smoke legs exercise the
+        # whole plane (placement, ledger, store, sentinel, gates) in
+        # seconds instead of minutes; its FID is NOT a perceptual
+        # number and must never appear in a tracked quality series.
+        evaluation=AttrDict(
+            every_n_iter=None,
+            metrics=["fid"],
+            extractor="inception",
+            max_batches=None,
+            store=True,
+            store_dir=None,
+            regression_threshold=0.05,
+            regression_consecutive=2,
+            ewma_beta=0.5,
         ),
         # -- 2-D (data x model) parallelism (parallel/partition.py,
         # ISSUE 6). mesh_shape opts in: {"data": N, "model": M} (or an

@@ -1,10 +1,19 @@
 """Evaluation metrics (ref: imaginaire/evaluation/): FID, KID, PRDC over
-Inception-v3 activations."""
+Inception-v3 activations — plus the ISSUE-18 quality observability
+plane (mesh-sharded continuous eval, content-addressed reference-
+feature store, EWMA regression sentinel)."""
 
 from imaginaire_tpu.evaluation.common import (
     get_activations,
     get_video_activations,
     preprocess_for_inception,
+)
+from imaginaire_tpu.evaluation.feature_store import (
+    FeatureStore,
+    evaluation_settings,
+    extractor_id,
+    reference_key,
+    resolve_store_dir,
 )
 from imaginaire_tpu.evaluation.fid import (
     calculate_frechet_distance,
@@ -13,12 +22,20 @@ from imaginaire_tpu.evaluation.fid import (
 )
 from imaginaire_tpu.evaluation.inception import InceptionV3, load_params, make_extractor
 from imaginaire_tpu.evaluation.kid import compute_kid, kid_from_activations
+from imaginaire_tpu.evaluation.plane import (
+    EvalPlane,
+    RegressionSentinel,
+    make_patch_extractor,
+)
 from imaginaire_tpu.evaluation.prdc import compute_prdc, prdc_from_activations
 
 __all__ = [
     "get_activations", "get_video_activations", "preprocess_for_inception",
+    "FeatureStore", "evaluation_settings", "extractor_id",
+    "reference_key", "resolve_store_dir",
     "calculate_frechet_distance", "compute_fid", "load_or_compute_stats",
     "InceptionV3", "load_params", "make_extractor",
     "compute_kid", "kid_from_activations",
+    "EvalPlane", "RegressionSentinel", "make_patch_extractor",
     "compute_prdc", "prdc_from_activations",
 ]

@@ -55,21 +55,42 @@ def get_activations(data_loader, key_real, key_fake, extractor,
 
     generator_fn: data -> fake images in [-1,1] NHWC, or None to read
     ``data[key_real]`` directly. Returns np (N, 2048) gathered over hosts.
+
+    ISSUE 18: the loop no longer runs dark under the watchdog's eval
+    exemption — each batch's generator forward lands in an
+    ``eval_generate`` span and the extractor forward + host sync in
+    ``eval_extract``, with an ``eval/batches`` counter per sweep, so
+    the report's phase table attributes eval wall-clock the same way it
+    does training steps. Real-image batches are placed through
+    ``place_committed_batch`` so the inception forward shards over the
+    mesh's data axis instead of running replicated on one device.
     """
+    from imaginaire_tpu import telemetry
+    from imaginaire_tpu.parallel.sharding import place_committed_batch
+
+    tm = telemetry.get()
     acts = []
+    batches = 0
     for it, data in enumerate(data_loader):
         if max_batches is not None and it >= max_batches:
             break
         if generator_fn is None:
             # device-prefetched batches are already placed jax arrays;
-            # only host batches need the numpy->device hop
+            # host batches get the committed data-axis placement
             images = data[key_real]
             if not isinstance(images, jax.Array):
-                images = jnp.asarray(np.asarray(images))
+                images = place_committed_batch(np.asarray(images))
         else:
-            images = generator_fn(data)
-        feats = extractor(preprocess_for_inception(images))
-        acts.append(np.asarray(feats))
+            with tm.span("eval_generate"):
+                images = generator_fn(data)
+        with tm.span("eval_extract"):
+            feats = extractor(preprocess_for_inception(images))
+            # np.asarray is the device->host sync: the span must absorb
+            # it or the extract time would be billed to the next batch
+            acts.append(np.asarray(feats))
+        batches += 1
+    if tm.enabled and batches:
+        tm.counter("eval/batches", batches)
     if not acts:
         return np.zeros((0, 2048), np.float32)
     return _allgather_if_multihost(np.concatenate(acts, axis=0))
@@ -80,6 +101,9 @@ def get_video_activations(data_loader, key_real, key_fake, trainer,
     """Video models: shard sequences round-robin by process index, reset
     the trainer per sequence, run test_single per frame
     (ref: common.py:79-158)."""
+    from imaginaire_tpu import telemetry
+
+    tm = telemetry.get()
     dataset = data_loader.dataset
     num_seq = dataset.num_inference_sequences()
     indices = list(range(num_seq))
@@ -89,6 +113,7 @@ def get_video_activations(data_loader, key_real, key_fake, trainer,
         indices = indices[:sample_size]
     indices = indices[jax.process_index()::jax.process_count()]
     acts = []
+    batches = 0
     for seq_idx in indices:
         dataset.set_inference_sequence_idx(seq_idx)
         if trainer is not None:
@@ -99,10 +124,15 @@ def get_video_activations(data_loader, key_real, key_fake, trainer,
                 if images.ndim == 5:  # (B, T=1, H, W, C) frame windows
                     images = images.reshape((-1,) + images.shape[2:])
             else:
-                out = trainer.test_single(data)
-                images = out["fake_images"]
-            feats = extractor(preprocess_for_inception(images))
-            acts.append(np.asarray(feats))
+                with tm.span("eval_generate"):
+                    out = trainer.test_single(data)
+                    images = out["fake_images"]
+            with tm.span("eval_extract"):
+                feats = extractor(preprocess_for_inception(images))
+                acts.append(np.asarray(feats))
+            batches += 1
+    if tm.enabled and batches:
+        tm.counter("eval/batches", batches)
     if not acts:
         return np.zeros((0, 2048), np.float32)
     return _allgather_if_multihost(np.concatenate(acts, axis=0))

@@ -1,0 +1,258 @@
+"""Content-addressed reference-feature store (ISSUE 18 tentpole).
+
+The reference repo recomputes the real-set Inception activations on
+every ``evaluate.py`` invocation — a frozen network applied to a frozen
+dataset, recomputed forever. The PR-4 flow-cache insight ("a frozen
+network's output over frozen data is content, not compute") applies
+verbatim: reference activations are a pure function of (dataset,
+extractor weights, eval resolution, preprocessing recipe), so they are
+computed once per that tuple EVER and persisted in the
+``flow/cache.py`` mold:
+
+- one ``.npz`` shard per key under ``<root>/<key[:2]>/<key>.npz``,
+  written atomically (uuid tmp + ``os.replace``) so concurrent eval
+  sweeps — or the N hosts of a pod sharing a filesystem — never read a
+  torn shard;
+- multi-writer safe: ``put`` skips keys another writer already
+  published (content-addressed keys make the bytes equivalent);
+- quarantine-on-corrupt: a shard that fails to parse after the bounded
+  retry budget is renamed ``*.corrupt`` (so it is never re-read every
+  sweep), counted in ``eval/store_corrupt``, and degrades to a miss —
+  the sweep simply recomputes;
+- keyed by dataset + extractor-weights identity + resolution +
+  preprocessing + feature-graph version, so a changed extractor (or
+  the count_include_pad fix bumping ``FEATURE_GRAPH_VERSION``) misses
+  instead of silently mixing feature spaces.
+
+Random-init extractors (``trainer.fid_random_init``, tests) get a
+per-process identity tag — their features differ per process, so they
+may hit within one run (the continuous-eval second sweep) but can
+never poison a shared store.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+
+import numpy as np
+
+from imaginaire_tpu.config import cfg_get
+
+logger = logging.getLogger(__name__)
+
+# Bump when the stored payload layout changes incompatibly; stale
+# shards then simply miss. The *numerics* of the features are versioned
+# separately by fid.FEATURE_GRAPH_VERSION, which rides every key.
+STORE_VERSION = 1
+
+# The canonical preprocessing recipe baked into every key: clip to
+# [-1,1], imagenet-normalize, bilinear-resize to 299 (common.py::
+# preprocess_for_inception). A future preprocessing variant must change
+# this string, not silently share shards with the old one.
+INCEPTION_PREPROCESS = "clip-imagenet-bilinear299"
+
+
+def evaluation_settings(cfg):
+    """Parse the ``cfg.evaluation`` group (missing -> disabled)."""
+    ecfg = cfg_get(cfg or {}, "evaluation", None) or {}
+    every = cfg_get(ecfg, "every_n_iter", None)
+    metrics = cfg_get(ecfg, "metrics", None) or ["fid"]
+    return {
+        "every_n_iter": None if not every else int(every),
+        "metrics": [str(m).lower() for m in metrics],
+        # inception (the real metric) | patch (mean-pooled pixel
+        # patches — a smoke-test stand-in that exercises the full plane
+        # at negligible cost; its FID is NOT a perceptual number)
+        "extractor": str(cfg_get(ecfg, "extractor", "inception")).lower(),
+        "max_batches": cfg_get(ecfg, "max_batches", None),
+        "store": bool(cfg_get(ecfg, "store", True)),
+        "store_dir": cfg_get(ecfg, "store_dir", None),
+        "regression_threshold": float(
+            cfg_get(ecfg, "regression_threshold", 0.05) or 0.05),
+        "regression_consecutive": int(
+            cfg_get(ecfg, "regression_consecutive", 2) or 2),
+        "ewma_beta": float(cfg_get(ecfg, "ewma_beta", 0.5) or 0.5),
+    }
+
+
+def resolve_store_dir(cfg):
+    """The on-disk store directory: ``evaluation.store_dir`` >
+    ``<logdir>/feature_store`` > None (the plane then recomputes every
+    sweep — the pre-ISSUE-18 behavior)."""
+    settings = evaluation_settings(cfg)
+    if settings["store_dir"]:
+        return str(settings["store_dir"])
+    logdir = cfg_get(cfg or {}, "logdir", None)
+    if logdir:
+        return os.path.join(str(logdir), "feature_store")
+    return None
+
+
+def extractor_id(weights_path=None, random_init=False):
+    """Identity of the extractor weights baked into every key: a
+    converted checkpoint is identified by (name, size, mtime); a
+    random-init extractor (tests, fid_random_init) gets a per-process
+    tag so its features never poison a shared store."""
+    from imaginaire_tpu.evaluation.fid import FEATURE_GRAPH_VERSION
+    from imaginaire_tpu.evaluation.inception import DEFAULT_WEIGHTS
+
+    graph = f"inception-g{FEATURE_GRAPH_VERSION}"
+    if random_init:
+        return f"{graph}:random-init:{os.getpid()}"
+    path = weights_path or DEFAULT_WEIGHTS
+    if path and os.path.exists(path):
+        st = os.stat(path)
+        return (f"{graph}:{os.path.basename(path)}:{st.st_size}"
+                f":{int(st.st_mtime)}")
+    return f"{graph}:random-init:{os.getpid()}"
+
+
+def reference_key(dataset_name, extractor, resolution,
+                  preprocessing=INCEPTION_PREPROCESS, split="val",
+                  max_batches=None):
+    """Content-addressed key for one reference-activation set.
+
+    ``resolution`` is the eval-time (H, W) the loader feeds (or a
+    string like "native"); ``max_batches`` rides the key because a
+    truncated sweep's activations are NOT the full set's."""
+    if isinstance(resolution, (tuple, list)):
+        resolution = f"{int(resolution[0])}x{int(resolution[1])}"
+    payload = "|".join([
+        f"v{STORE_VERSION}", str(dataset_name), str(split),
+        str(resolution), str(preprocessing), str(extractor),
+        f"max_batches={max_batches}",
+    ])
+    return hashlib.sha1(payload.encode()).hexdigest()
+
+
+class FeatureStore:
+    """Content-addressed reference-activation shards on disk.
+
+    One ``.npz`` per key holding the float32 (N, D) activation matrix
+    (FID's covariance is what the gate thresholds — features are stored
+    at full precision, unlike the flow store's fp16). Writes are atomic
+    (uuid tmp + rename) so concurrent sweeps never read torn shards.
+    """
+
+    def __init__(self, root):
+        self.root = str(root)
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.corrupt_shards = 0
+
+    def path(self, key):
+        return os.path.join(self.root, key[:2], key + ".npz")
+
+    def has(self, key):
+        return os.path.exists(self.path(key))
+
+    def _read(self, path):
+        """One shard read — the retried unit (transient OSErrors recover
+        on the next attempt) and the chaos harness's feature-store
+        site."""
+        from imaginaire_tpu.resilience import chaos
+
+        chaos.get().maybe_io_error("feature_store")
+        with np.load(path) as npz:
+            return npz["acts"].astype(np.float32)
+
+    def _quarantine(self, path, error):
+        """A corrupt shard degrades to a miss ONCE: renamed to
+        ``*.corrupt`` so it is never re-read (and re-missed) every
+        sweep, counted in ``eval/store_corrupt``."""
+        from imaginaire_tpu import telemetry
+
+        with self._lock:
+            self.corrupt_shards += 1
+            count = self.corrupt_shards
+        try:
+            os.replace(path, path + ".corrupt")
+        except FileNotFoundError:
+            # another host of a shared store already quarantined it
+            pass
+        except OSError:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        logger.warning("feature store: quarantined corrupt shard %s (%s)",
+                       path, error)
+        tm = telemetry.get()
+        if tm.enabled:
+            tm.counter("eval/store_corrupt", count)
+            tm.meta("eval/store_corrupt_shard", shard=str(path),
+                    error=str(error)[:200])
+
+    def get(self, key):
+        """float32 (N, D) activations or None. Transient IO retries
+        with bounded backoff (resilience/retry.py); a shard that still
+        fails — or fails to parse — is quarantined and degrades to a
+        miss (the sweep simply recomputes)."""
+        import zipfile
+
+        from imaginaire_tpu.resilience import retry_call
+
+        path = self.path(key)
+        if not os.path.exists(path):
+            with self._lock:
+                self.misses += 1
+            return None
+        try:
+            acts = retry_call(self._read, path, label="feature_store")
+        except (OSError, KeyError, ValueError, EOFError,
+                zipfile.BadZipFile) as e:
+            self._quarantine(path, e)
+            with self._lock:
+                self.misses += 1
+            return None
+        with self._lock:
+            self.hits += 1
+        return acts
+
+    def put(self, key, acts, **meta_fields):
+        from imaginaire_tpu.resilience import retry_call
+
+        path = self.path(key)
+        if os.path.exists(path):
+            # multi-writer shared directory: another sweep/host already
+            # published this shard — content-addressed keys make its
+            # bytes equivalent, so skip the redundant write (and the
+            # rename-over-live-file hazard on non-POSIX-atomic shared
+            # filesystems)
+            return
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # tmp name unique across THREADS and HOSTS: pids collide between
+        # machines sharing a filesystem, so a random token joins the
+        # pid/tid pair (np.savez appends '.npz' unless the name already
+        # ends with it)
+        import uuid
+
+        tmp = (f"{path}.{os.getpid()}.{threading.get_ident()}."
+               f"{uuid.uuid4().hex[:8]}.tmp.npz")
+
+        def _write():
+            np.savez(tmp, acts=np.asarray(acts, np.float32),
+                     store_version=STORE_VERSION,
+                     **{k: np.asarray(v) for k, v in meta_fields.items()})
+            os.replace(tmp, path)
+
+        try:
+            retry_call(_write, label="feature_store_write")
+        except OSError as e:
+            logger.warning("feature store write failed for %s: %s",
+                           path, e)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    def stats(self):
+        with self._lock:
+            total = self.hits + self.misses
+            return {"hits": self.hits, "misses": self.misses,
+                    "corrupt_shards": self.corrupt_shards,
+                    "hit_rate": (self.hits / total) if total else 0.0}

@@ -1,0 +1,266 @@
+"""Mesh-sharded continuous-eval plane (ISSUE 18 tentpole).
+
+Quality was the last unobserved axis: throughput regressions gate CI
+(bench legs, `check_run_health`), but no FID ever reached telemetry —
+`evaluate.py` ran offline, serial, and recomputed its reference
+features every invocation. This module makes "did the model get worse"
+as observable as "did the step get slower":
+
+- **Sharded sweep**: eval batches go through ``place_committed_batch``
+  (the same committed data-axis placement as training batches), the
+  ledgered inception extractor runs the forward data-parallel over the
+  mesh, and per-host activations join through the timed
+  ``host_all_gather`` — a host lost mid-sweep raises a named desync on
+  the survivors instead of hanging the pod.
+- **Reference store**: real-set activations come from the
+  content-addressed ``FeatureStore`` — computed once per (dataset,
+  extractor weights, resolution, preprocessing) ever, hit/miss visible
+  as ``eval/ref_cache_hit``.
+- **One schema**: every sweep — continuous (trainers/base.py cadence
+  hook) or offline (evaluate.py) — emits the same ``eval/fid``,
+  ``eval/kid``, ``eval/time_to_fid_ms``, ``eval/ref_cache_hit``
+  counters and ``eval/sweep`` meta into the run's jsonl, so
+  `report.py` renders one "## quality" trend table and
+  `check_run_health --max-fid` gates either kind of run.
+- **Regression sentinel**: an EWMA baseline over sweep FIDs; a sweep
+  worse than the baseline by more than ``regression_threshold``
+  (relative) for ``regression_consecutive`` sweeps in a row emits an
+  ``eval/regression`` meta naming the metric, step, and delta, and
+  bumps the cumulative ``eval/regressions`` counter that
+  ``--max-quality-regressions`` gates on.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+
+from imaginaire_tpu import telemetry
+from imaginaire_tpu.evaluation.feature_store import (
+    FeatureStore,
+    evaluation_settings,
+    extractor_id,
+    reference_key,
+    resolve_store_dir,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def make_patch_extractor(grid=8):
+    """Mean-pooled pixel-patch features: (B, H, W, C) -> (B, grid*grid*C).
+
+    A smoke-test stand-in for the Inception extractor
+    (``cfg.evaluation.extractor: patch``): distribution distances over
+    pooled pixel statistics still move when the generator's output
+    drifts, which is all the CI legs need — while the forward is a
+    single resize and the FID covariance shrinks from 2048^2 to
+    (grid^2*C)^2, turning a ~10 s scipy sqrtm into milliseconds. NOT a
+    perceptual metric; never record its numbers in a tracked series.
+    Compiles through the ledger like the real extractor so the plane's
+    accounting path stays identical."""
+    import jax
+
+    from imaginaire_tpu.telemetry import xla_obs
+
+    def run(images):
+        b, _, _, c = images.shape
+        x = jax.image.resize(images.astype("float32"),
+                             (b, grid, grid, c), method="linear")
+        return x.reshape(b, grid * grid * c)
+
+    program = xla_obs.compiled_program("patch_eval_extractor", run,
+                                       allow_shape_growth=True)
+
+    def extractor(images):
+        return program(images)
+
+    extractor.program = program  # audit/ledger surface
+    return extractor
+
+
+class RegressionSentinel:
+    """EWMA quality-trend detector over sweep FIDs.
+
+    FID is noisy sweep-to-sweep (subset sampling, generator
+    stochasticity), so the baseline is an EWMA rather than the previous
+    point, the comparison is *relative* (a 0.05 threshold means "5%
+    worse than trend"), and a single bad sweep never fires — only
+    ``consecutive`` breaches in a row do. Lower FID is better, so only
+    positive deltas (worsening) count; improvements reset the streak
+    and pull the baseline down.
+    """
+
+    def __init__(self, threshold=0.05, consecutive=2, beta=0.5):
+        self.threshold = float(threshold)
+        self.consecutive = max(1, int(consecutive))
+        self.beta = float(beta)
+        self.ewma = None
+        self.streak = 0
+        self.fired = 0
+
+    def observe(self, value, step=None, metric="fid"):
+        """Feed one sweep's metric; returns a regression dict when the
+        sentinel fires (and emits the ``eval/regression`` meta +
+        ``eval/regressions`` counter), else None."""
+        value = float(value)
+        fired = None
+        if self.ewma is not None and np.isfinite(self.ewma):
+            delta = (value - self.ewma) / max(abs(self.ewma), 1e-8)
+            if delta > self.threshold:
+                self.streak += 1
+            else:
+                self.streak = 0
+            if self.streak >= self.consecutive:
+                self.fired += 1
+                fired = {
+                    "metric": metric, "step": step,
+                    "value": round(value, 4),
+                    "baseline": round(float(self.ewma), 4),
+                    "delta": round(float(delta), 4),
+                    "threshold": self.threshold,
+                    "streak": self.streak,
+                }
+                tm = telemetry.get()
+                if tm.enabled:
+                    tm.meta("eval/regression", **fired)
+                    tm.counter("eval/regressions", self.fired, step=step)
+                logger.warning(
+                    "quality regression: %s %.3f vs EWMA baseline %.3f "
+                    "(+%.1f%%, %d consecutive breaches) at step %s",
+                    metric, value, self.ewma, 100.0 * delta,
+                    self.streak, step)
+        if self.ewma is None or not np.isfinite(self.ewma):
+            self.ewma = value
+        else:
+            self.ewma = self.beta * self.ewma + (1.0 - self.beta) * value
+        return fired
+
+
+class EvalPlane:
+    """One training/eval process's quality-observability plane.
+
+    Owns the reference-feature store, the regression sentinel, and the
+    sweep counter; ``run_sweep`` is the single entry point both the
+    continuous-eval cadence hook (trainers/base.py) and offline
+    ``evaluate.py`` route through, so both emit the identical ``eval/*``
+    schema.
+    """
+
+    def __init__(self, cfg=None, logdir=None, store_dir=None):
+        self.settings = evaluation_settings(cfg)
+        self.sentinel = RegressionSentinel(
+            threshold=self.settings["regression_threshold"],
+            consecutive=self.settings["regression_consecutive"],
+            beta=self.settings["ewma_beta"])
+        root = store_dir or resolve_store_dir(cfg)
+        if root is None and logdir:
+            import os
+
+            root = os.path.join(str(logdir), "feature_store")
+        self.store = (FeatureStore(root)
+                      if (root and self.settings["store"]) else None)
+        self.sweeps = 0
+
+    # -- reference side -------------------------------------------------
+    def reference_activations(self, data_loader, key_real, extractor,
+                              dataset_name="dataset", resolution="native",
+                              weights_path=None, random_init=False,
+                              max_batches=None, extractor_tag=None):
+        """Real-set activations through the store: content-addressed
+        get, compute-on-miss (sharded, instrumented), atomic put.
+        Returns (acts, hit) — ``hit`` feeds ``eval/ref_cache_hit``
+        honestly (no in-memory shortcut: a second sweep's hit proves
+        the on-disk shard round-trips). ``extractor_tag`` overrides the
+        inception weights identity for non-inception extractors (the
+        patch smoke extractor) so their shards never collide."""
+        from imaginaire_tpu.evaluation.common import get_activations
+
+        eid = extractor_tag or extractor_id(weights_path=weights_path,
+                                            random_init=random_init)
+        key = reference_key(dataset_name, eid, resolution,
+                            max_batches=max_batches)
+        if self.store is not None:
+            acts = self.store.get(key)
+            if acts is not None:
+                return acts, True
+        acts = get_activations(data_loader, key_real, None, extractor,
+                               generator_fn=None, max_batches=max_batches)
+        if self.store is not None and acts.shape[0]:
+            self.store.put(key, acts, dataset=dataset_name,
+                           extractor=eid, resolution=str(resolution))
+        return acts, False
+
+    # -- the sweep ------------------------------------------------------
+    def run_sweep(self, data_loader, key_real, key_fake, extractor,
+                  generator_fn, step=None, dataset_name="dataset",
+                  resolution="native", weights_path=None,
+                  random_init=False, max_batches=None, metrics=None,
+                  extractor_tag=None):
+        """One full quality sweep: reference acts via the store, fake
+        acts via the sharded instrumented loop, FID (+ optional KID),
+        counters, sentinel. Returns the results dict (also suitable for
+        the caller's meters/jsonl)."""
+        from imaginaire_tpu.evaluation.common import get_activations
+        from imaginaire_tpu.evaluation.fid import (
+            activation_stats,
+            calculate_frechet_distance,
+        )
+        from imaginaire_tpu.resilience import chaos
+
+        metrics = [m.lower() for m in (metrics or self.settings["metrics"])]
+        max_batches = (max_batches if max_batches is not None
+                       else self.settings["max_batches"])
+        self.sweeps += 1
+        sweep = self.sweeps
+        t0 = time.perf_counter()
+        tm = telemetry.get()
+
+        act_real, ref_hit = self.reference_activations(
+            data_loader, key_real, extractor, dataset_name=dataset_name,
+            resolution=resolution, weights_path=weights_path,
+            random_init=random_init, max_batches=max_batches,
+            extractor_tag=extractor_tag)
+        act_fake = get_activations(
+            data_loader, key_real, key_fake, extractor,
+            generator_fn=generator_fn, max_batches=max_batches)
+        if not act_real.shape[0] or not act_fake.shape[0]:
+            logger.warning("eval sweep %d produced empty activation sets "
+                           "(real=%d fake=%d) — skipping metrics",
+                           sweep, act_real.shape[0], act_fake.shape[0])
+            return None
+
+        mu_r, sig_r = activation_stats(act_real)
+        mu_f, sig_f = activation_stats(act_fake)
+        fid = float(calculate_frechet_distance(mu_r, sig_r, mu_f, sig_f))
+        fid = chaos.get().maybe_degrade_eval(fid, sweep)
+        out = {"fid": fid, "sweep": sweep, "step": step,
+               "ref_cache_hit": bool(ref_hit),
+               "num_real": int(act_real.shape[0]),
+               "num_fake": int(act_fake.shape[0])}
+        if "kid" in metrics:
+            from imaginaire_tpu.evaluation.kid import kid_from_activations
+
+            out["kid"] = float(kid_from_activations(act_real, act_fake))
+        elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        out["time_to_fid_ms"] = elapsed_ms
+
+        if tm.enabled:
+            tm.counter("eval/fid", fid, step=step)
+            if "kid" in out:
+                tm.counter("eval/kid", out["kid"], step=step)
+            tm.counter("eval/time_to_fid_ms", elapsed_ms, step=step)
+            tm.counter("eval/ref_cache_hit", 1 if ref_hit else 0,
+                       step=step)
+            tm.meta("eval/sweep", **{k: v for k, v in out.items()
+                                     if k != "step"}, step=step,
+                    dataset=str(dataset_name))
+        regression = self.sentinel.observe(fid, step=step)
+        if regression is not None:
+            out["regression"] = regression
+        return out
+
+    def store_stats(self):
+        return self.store.stats() if self.store is not None else None

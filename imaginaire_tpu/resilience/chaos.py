@@ -15,8 +15,12 @@ machinery production relies on:
   that iteration (exercises the in-graph non-finite guard + triage).
 - ``io_error_at_step``           — raise a one-shot ``ChaosIOError``
   from the configured site (``io_error_site``: ``flow_store`` |
-  ``loader``) on that site's Nth access (exercises the bounded-retry
-  wrapper).
+  ``loader`` | ``feature_store``) on that site's Nth access (exercises
+  the bounded-retry wrapper).
+- ``degrade_eval_at_sweep``      — inflate measured FID from that eval
+  sweep onward (exercises the ISSUE-18 regression sentinel and the
+  ``--max-quality-regressions`` gate). Persistent rather than one-shot:
+  the sentinel requires K consecutive bad sweeps.
 
 Every injection is one-shot per (kind, step) and emits a
 ``chaos/<kind>`` telemetry meta event, so a chaos run's jsonl records
@@ -75,6 +79,12 @@ def chaos_settings(cfg):
             cfg_get(ccfg, "diverge_process_index", 0) or 0),
         "diverge_scale": float(cfg_get(ccfg, "diverge_scale", 1e-3)
                                or 1e-3),
+        # quality degradation (ISSUE 18): inflate measured FID from the
+        # Nth eval sweep (1-based) onward — persistent, because the
+        # regression sentinel needs K consecutive bad sweeps
+        "degrade_eval_at_sweep": step("degrade_eval_at_sweep"),
+        "degrade_eval_scale": float(cfg_get(ccfg, "degrade_eval_scale",
+                                            1.0) or 1.0),
     }
 
 
@@ -225,6 +235,20 @@ class ChaosMonkey:
         return {k: float(v) * (1.0 + scale) + scale
                 for k, v in (losses or {}).items()}
 
+    def maybe_degrade_eval(self, fid, sweep_index):
+        """Quality degradation (ISSUE 18): return ``fid`` inflated by
+        ``degrade_eval_scale`` (relative) from sweep
+        ``degrade_eval_at_sweep`` onward — NOT one-shot, because the
+        regression sentinel only fires on K consecutive bad sweeps; a
+        single degraded point models measurement noise, a persistent
+        one models a regressed model. The ``chaos/degrade_eval`` meta
+        is still emitted exactly once, at the first degraded sweep."""
+        at = self.settings["degrade_eval_at_sweep"]
+        if not self.enabled or at is None or sweep_index < at:
+            return fid
+        self._should("degrade_eval", at, at)  # one-shot meta marker
+        return float(fid) * (1.0 + self.settings["degrade_eval_scale"])
+
     def maybe_io_error(self, site):
         """Raise a one-shot ``ChaosIOError`` on the configured site's
         Nth access (sites count their own calls — loader/flow-store
@@ -263,6 +287,9 @@ class _NullChaos:
 
     def maybe_perturb_losses(self, losses, step):
         return losses
+
+    def maybe_degrade_eval(self, fid, sweep_index):
+        return fid
 
     def maybe_io_error(self, site):
         pass

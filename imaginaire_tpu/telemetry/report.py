@@ -64,6 +64,9 @@ def summarize(events):
     pod_straggler_events = []
     pod_divergence_events = []
     pod_digest_count = 0
+    eval_series = {}
+    eval_sweep_events = []
+    regression_events = []
     meta = {}
     hangs = []
     t_min = t_max = None
@@ -95,6 +98,11 @@ def summarize(events):
                 # latest value
                 pod_skew_series.append(
                     [ev.get("step"), float(ev.get("value") or 0.0)])
+            elif str(ev["name"]).startswith("eval/"):
+                # full series for quality counters (ISSUE 18): the
+                # report renders the per-sweep trend, not the latest
+                eval_series.setdefault(ev["name"], []).append(
+                    [ev.get("step"), ev.get("value")])
         elif kind == "meta":
             name = ev.get("name")
             if name == "nonfinite":
@@ -136,6 +144,10 @@ def summarize(events):
                 pod_straggler_events.append(ev)
             elif name == "pod/divergence":
                 pod_divergence_events.append(ev)
+            elif name == "eval/sweep":
+                eval_sweep_events.append(ev)
+            elif name == "eval/regression":
+                regression_events.append(ev)
             elif str(name).startswith("chaos/"):
                 chaos_events.append(ev)
             meta[ev.get("name", "?")] = ev
@@ -325,10 +337,37 @@ def summarize(events):
             "share": straggler_counters[leader] / max(total, 1),
             "span": span,
         }
+    # quality observability plane (ISSUE 18): full eval/* counter
+    # series (FID/KID trend over sweeps), the per-sweep meta events,
+    # and the regression sentinel's firings — check_run_health
+    # --max-fid / --max-quality-regressions gate on these
+    fid_series = eval_series.get("eval/fid", [])
+    fid_vals = [v for _, v in fid_series
+                if isinstance(v, (int, float))]
+    ref_hits = [int(v or 0) for _, v in
+                eval_series.get("eval/ref_cache_hit", [])]
+    quality = {
+        "present": bool(eval_series or eval_sweep_events
+                        or regression_events),
+        "series": eval_series,
+        "sweeps": eval_sweep_events,
+        "sweep_count": max(len(fid_series), len(eval_sweep_events)),
+        "fid_latest": fid_vals[-1] if fid_vals else None,
+        "fid_best": min(fid_vals) if fid_vals else None,
+        "regressions": int(
+            counters.get("eval/regressions", (0, None))[0] or 0)
+        or len(regression_events),
+        "regression_events": regression_events,
+        "ref_cache_hits": sum(ref_hits),
+        "ref_cache_misses": len(ref_hits) - sum(ref_hits),
+        "store_corrupt": int(
+            counters.get("eval/store_corrupt", (0, None))[0] or 0),
+    }
     return {"phases": table, "counters": counters, "meta": meta,
             "hangs": hangs, "wall_s": wall_s, "health": health,
             "flow_cache": flow_cache, "xla": xla,
-            "resilience": resilience, "graph": graph, "pod": pod}
+            "resilience": resilience, "graph": graph, "pod": pod,
+            "quality": quality}
 
 
 def _trend(series):
@@ -560,6 +599,59 @@ def _elasticity_section(s):
     return lines
 
 
+def _quality_section(s):
+    """Markdown lines for the quality observability section (ISSUE
+    18): the per-sweep FID/KID trend table, reference-store hit
+    accounting, and the regression sentinel's verdict. Empty when the
+    run ran no eval sweeps."""
+    q = s.get("quality") or {}
+    if not q.get("present"):
+        return []
+    series = q.get("series", {})
+    lines = ["", "## quality"]
+    fid = {step: v for step, v in series.get("eval/fid", [])}
+    kid = {step: v for step, v in series.get("eval/kid", [])}
+    ttf = {step: v for step, v in
+           series.get("eval/time_to_fid_ms", [])}
+    hit = {step: v for step, v in
+           series.get("eval/ref_cache_hit", [])}
+    steps = [step for step, _ in series.get("eval/fid", [])]
+    if steps:
+        lines.append("| sweep | step | fid | kid | time-to-fid ms "
+                     "| ref hit |")
+        lines.append("|---|---|---|---|---|---|")
+        for i, step in enumerate(steps):
+            kid_v = kid.get(step)
+            ttf_v = ttf.get(step)
+            lines.append(
+                f"| {i + 1} | {step} | {fid.get(step, 0):.3f} "
+                f"| {f'{kid_v:.5f}' if kid_v is not None else '-'} "
+                f"| {f'{ttf_v:.0f}' if ttf_v is not None else '-'} "
+                f"| {'yes' if hit.get(step) else 'no'} |")
+    hits, misses = q.get("ref_cache_hits", 0), q.get("ref_cache_misses", 0)
+    if hits or misses:
+        lines.append(f"- reference store: {hits} hit(s), {misses} "
+                     f"miss(es)"
+                     + (f", !! {q['store_corrupt']} corrupt shard(s) "
+                        f"quarantined" if q.get("store_corrupt") else ""))
+    if q.get("fid_best") is not None:
+        lines.append(f"- fid: best {q['fid_best']:.3f}, latest "
+                     f"{q['fid_latest']:.3f} over "
+                     f"{q.get('sweep_count', 0)} sweep(s)")
+    n_reg = q.get("regressions", 0)
+    if n_reg:
+        lines.append(f"!! quality regressions: {n_reg}")
+        for ev in q.get("regression_events", [])[:5]:
+            lines.append(
+                f"  - {ev.get('metric')} {ev.get('value')} vs baseline "
+                f"{ev.get('baseline')} (+{100 * float(ev.get('delta') or 0):.1f}%"
+                f", {ev.get('streak')} consecutive) at step "
+                f"{ev.get('step')}")
+    else:
+        lines.append("- quality regressions: 0")
+    return lines
+
+
 def _pod_section(s):
     """Markdown lines for the pod observability section (ISSUE 17):
     cross-host step skew, straggler attribution, and the divergence
@@ -646,6 +738,7 @@ def render_report(path_or_events):
     lines.extend(_graph_section(s))
     lines.extend(_resilience_section(s))
     lines.extend(_elasticity_section(s))
+    lines.extend(_quality_section(s))
     lines.extend(_pod_section(s))
     if s["hangs"]:
         lines.append("")

@@ -557,14 +557,17 @@ class BaseTrainer:
 
     def _eval_preprocess(self, data):
         """Side-effect-free per-batch prep for metric sweeps: host hook
-        + transfer, skipped when a DevicePrefetcher already did both."""
+        + transfer, skipped when a DevicePrefetcher already did both.
+        ISSUE 18: the transfer is the committed data-axis placement, so
+        the eval generator forward shards over the mesh exactly like a
+        training step instead of running replicated."""
         from imaginaire_tpu.data.device_prefetch import PrefetchedBatch
 
         if isinstance(data, PrefetchedBatch):
             return data
-        from imaginaire_tpu.utils.misc import to_device
+        from imaginaire_tpu.parallel.sharding import place_committed_batch
 
-        return to_device(self._start_of_iteration(data, -1))
+        return place_committed_batch(self._start_of_iteration(data, -1))
 
     def _maybe_profile(self, current_iteration):
         """XLA profiler trace window (the jax-native replacement for the
@@ -619,6 +622,14 @@ class BaseTrainer:
             self.write_metrics()
         if current_iteration % cfg_get(cfg, "image_save_iter", 10000) == 0:
             self.save_image(self._image_path(current_iteration), data)
+        # continuous eval (ISSUE 18): mid-training FID/KID sweeps at the
+        # cfg.evaluation.every_n_iter cadence, through the sharded plane
+        # + reference store — quality lands in the same jsonl the
+        # throughput counters do
+        eval_every = cfg_get(cfg_get(cfg, "evaluation", {}) or {},
+                             "every_n_iter", None)
+        if eval_every and current_iteration % int(eval_every) == 0:
+            self.continuous_eval(current_iteration)
 
     def end_of_epoch(self, data, current_epoch, current_iteration):
         """(ref: base.py:375-405)."""
@@ -836,6 +847,107 @@ class BaseTrainer:
             self._meter("FID").write(float(fid))
             self._meter("best_FID").write(float(self.best_fid))
             self._flush_meters(self.current_iteration)
+
+    # -------------------------------------------- quality plane (ISSUE 18)
+
+    def eval_plane(self):
+        """The trainer's quality-observability plane (lazy: the store
+        directory and sentinel state live for the whole run, so sweep N
+        hits the reference shard sweep 1 wrote and the EWMA trend spans
+        the run)."""
+        if getattr(self, "_eval_plane", None) is None:
+            from imaginaire_tpu.evaluation.plane import EvalPlane
+
+            self._eval_plane = EvalPlane(
+                self.cfg, logdir=cfg_get(self.cfg, "logdir", "."))
+        return self._eval_plane
+
+    def _eval_resolution(self):
+        """The eval-time resolution tag riding the reference-store key
+        (from the val pipeline's deterministic sizing knobs; 'native'
+        when none constrain it)."""
+        data_cfg = cfg_get(self.cfg, "data", {}) or {}
+        for group in (cfg_get(data_cfg, "val", None) or {}, data_cfg):
+            aug = cfg_get(group, "augmentations", None) or {}
+            for key in ("center_crop_h_w", "resize_h_w",
+                        "random_crop_h_w"):
+                value = cfg_get(aug, key, None)
+                if value:
+                    return str(value).replace(" ", "").replace(",", "x")
+            side = cfg_get(aug, "resize_smallest_side", None)
+            if side:
+                return f"ss{int(side)}"
+        return "native"
+
+    def run_quality_sweep(self, step=None, metrics=None, max_batches=None):
+        """One sweep through the sharded eval plane: reference acts via
+        the content-addressed store, fake acts via the instrumented
+        mesh-placed loop, FID (+KID) with ``eval/*`` counters and the
+        regression sentinel. The single entry point continuous eval
+        (``continuous_eval``) and offline ``evaluate.py`` share, so
+        both emit one schema. Returns the plane's results dict or None
+        (no val loader / no image-family generator closure / missing
+        inception weights)."""
+        if self.val_data_loader is None:
+            return None
+        make_gen = getattr(self, "_make_eval_gen_fn", None)
+        vars_g = (self.state or {}).get("vars_G") \
+            if isinstance(self.state, dict) else None
+        if make_gen is None or vars_g is None:
+            return None
+        plane = self.eval_plane()
+        extractor_tag = None
+        if plane.settings.get("extractor") == "patch":
+            # CI smoke extractor: the whole plane (placement, ledger,
+            # store, sentinel) at negligible cost; tagged so its shards
+            # never collide with real inception features
+            from imaginaire_tpu.evaluation.plane import make_patch_extractor
+
+            if getattr(self, "_cached_patch_extractor", None) is None:
+                self._cached_patch_extractor = make_patch_extractor()
+            extractor = self._cached_patch_extractor
+            extractor_tag = "patch-v1:g8"
+            random_init = False
+        else:
+            try:
+                extractor = self._fid_extractor()
+            except FileNotFoundError as e:
+                print(f"quality sweep skipped: {e}")
+                return None
+            random_init = cfg_get(cfg_get(self.cfg, "trainer", {}),
+                                  "fid_random_init", False)
+        dataset_name = cfg_get(cfg_get(self.cfg, "data", {}) or {},
+                               "name", "data")
+        val_loader = self.data_prefetcher(self.val_data_loader)
+        return plane.run_sweep(
+            val_loader, "images", "fake_images", extractor,
+            make_gen(vars_g),
+            step=self.current_iteration if step is None else step,
+            dataset_name=dataset_name, resolution=self._eval_resolution(),
+            random_init=random_init, max_batches=max_batches,
+            metrics=metrics, extractor_tag=extractor_tag)
+
+    def continuous_eval(self, step, metrics=None):
+        """The ``cfg.evaluation.every_n_iter`` cadence hook: a full
+        quality sweep inside the watchdog-exempt eval span (sweeps are
+        legitimately step-shaped-free time; the heartbeat re-arms from
+        span exit), feeding the FID/best_FID meters like the
+        snapshot-time ``write_metrics`` path does. ``evaluate.py``
+        calls it per checkpoint with an explicit metrics list."""
+        with telemetry.span("eval", step=step):
+            result = self.run_quality_sweep(step=step, metrics=metrics)
+        telemetry.get().heartbeat(step)
+        if result is None:
+            return None
+        fid = result["fid"]
+        if getattr(self, "best_fid", None) is None or fid < self.best_fid:
+            self.best_fid = fid
+        self._meter("FID").write(float(fid))
+        self._meter("best_FID").write(float(self.best_fid))
+        if "kid" in result:
+            self._meter("KID").write(float(result["kid"]))
+        self._flush_meters(step)
+        return result
 
     # --------------------------------------------------------- persistence
 

@@ -22,7 +22,12 @@ Checks (each can fail the gate):
   ``--max-step-skew-ms``, SPMD divergence sentinel events beyond
   ``--max-divergence`` (pass 0 — fp32 data-parallel replicas must stay
   bit-identical), and a persistent straggler's slowest-round share
-  beyond ``--max-straggler-share``. Runs without pod counters pass.
+  beyond ``--max-straggler-share``. Runs without pod counters pass;
+- quality observability (ISSUE 18): the latest sweep's FID beyond
+  ``--max-fid`` and regression-sentinel firings beyond
+  ``--max-quality-regressions`` (pass 0 — a model that got worse and
+  stayed worse fails CI like a slow step does). Runs without eval
+  counters pass.
 
 Multi-host pods (ISSUE 8): every process writes its own
 ``telemetry.jsonl.p<i>`` — ``--hosts`` aggregates ALL per-process files
@@ -60,7 +65,8 @@ def check_health(summary, require_health=False, max_dg_breaches=0,
                  max_graph_violations=0,
                  max_resizes=None, min_world_size=None,
                  max_step_skew_ms=None, max_divergence=None,
-                 max_straggler_share=None):
+                 max_straggler_share=None, max_fid=None,
+                 max_quality_regressions=None):
     """Return the list of failure strings for an aggregated summary."""
     failures = []
     health = summary.get("health") or {}
@@ -235,6 +241,34 @@ def check_health(summary, require_health=False, max_dg_breaches=0,
                 f"(slowest in {share:.0%} of rounds, span "
                 f"{straggler.get('span') or 'n/a'}) exceeds "
                 f"--max-straggler-share {max_straggler_share:g}")
+    # quality gates (ISSUE 18): the latest sweep's FID against an
+    # absolute ceiling, and the EWMA regression sentinel's firing count
+    # against a budget (pass 0 — a healthy run's quality trend never
+    # worsens past threshold for K consecutive sweeps). Only runs that
+    # carried eval/* counters are gated (the graph-gate idiom): a
+    # training run without continuous eval passes unchanged.
+    quality = summary.get("quality") or {}
+    if quality.get("present"):
+        fid_latest = quality.get("fid_latest")
+        if max_fid is not None and fid_latest is not None \
+                and fid_latest > max_fid:
+            failures.append(
+                f"latest FID {fid_latest:.3f} exceeds --max-fid "
+                f"{max_fid:g} (best {quality.get('fid_best'):.3f} over "
+                f"{quality.get('sweep_count', 0)} sweep(s))")
+        n_reg = quality.get("regressions", 0)
+        if max_quality_regressions is not None \
+                and n_reg > max_quality_regressions:
+            deltas = [
+                f"step {e.get('step')}: {e.get('metric')} "
+                f"{e.get('value')} vs {e.get('baseline')} "
+                f"(+{100 * float(e.get('delta') or 0):.0f}%)"
+                for e in quality.get("regression_events", [])]
+            failures.append(
+                f"{n_reg} quality regression(s) (allowed "
+                f"{max_quality_regressions})"
+                + (f": {deltas[:3]}" if deltas else "")
+                + " — the model got worse and stayed worse")
     if require_health and not health.get("has_health_counters"):
         failures.append(
             "no health/* counters in the run (diagnostics disabled or "
@@ -322,6 +356,16 @@ def main(argv=None):
                          "than this fraction of digest rounds "
                          "(pod/straggler/* counters; default: no "
                          "straggler gate)")
+    ap.add_argument("--max-fid", type=float, default=None,
+                    help="fail when the latest eval sweep's FID "
+                         "(eval/fid counter) exceeds this (default: no "
+                         "FID gate; runs without eval counters pass)")
+    ap.add_argument("--max-quality-regressions", type=int, default=None,
+                    help="tolerated regression-sentinel firings "
+                         "(eval/regressions counter — FID worse than "
+                         "the EWMA trend past threshold for K "
+                         "consecutive sweeps; pass 0 to fail on any. "
+                         "Default: no regression gate)")
     ap.add_argument("--hosts", action="store_true",
                     help="aggregate every per-process telemetry file "
                          "(telemetry.jsonl + telemetry.jsonl.p*) of a "
@@ -355,7 +399,10 @@ def main(argv=None):
                             min_world_size=args.min_world_size,
                             max_step_skew_ms=args.max_step_skew_ms,
                             max_divergence=args.max_divergence,
-                            max_straggler_share=args.max_straggler_share)
+                            max_straggler_share=args.max_straggler_share,
+                            max_fid=args.max_fid,
+                            max_quality_regressions=
+                            args.max_quality_regressions)
     health = summary.get("health") or {}
     xla = summary.get("xla") or {}
     res = summary.get("resilience") or {}
@@ -406,6 +453,20 @@ def main(argv=None):
                     "divergence_count", 0),
                 "straggler": (summary.get("pod") or {}).get("straggler"),
             },
+            "quality": {
+                "present": (summary.get("quality") or {}).get(
+                    "present", False),
+                "fid_latest": (summary.get("quality") or {}).get(
+                    "fid_latest"),
+                "fid_best": (summary.get("quality") or {}).get(
+                    "fid_best"),
+                "sweep_count": (summary.get("quality") or {}).get(
+                    "sweep_count", 0),
+                "regressions": (summary.get("quality") or {}).get(
+                    "regressions", 0),
+                "ref_cache_hits": (summary.get("quality") or {}).get(
+                    "ref_cache_hits", 0),
+            },
         }, indent=1, default=str))
     elif failures:
         for failure in failures:
@@ -449,7 +510,10 @@ def _main_hosts(args):
                                 max_step_skew_ms=args.max_step_skew_ms,
                                 max_divergence=args.max_divergence,
                                 max_straggler_share=
-                                args.max_straggler_share)
+                                args.max_straggler_share,
+                                max_fid=args.max_fid,
+                                max_quality_regressions=
+                                args.max_quality_regressions)
         verdicts[label] = {"path": fpath, "healthy": not failures,
                            "failures": failures}
         any_fail = any_fail or bool(failures)

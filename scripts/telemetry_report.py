@@ -3,7 +3,9 @@
 attribution table (counts, totals, p50/p99, share of wall) plus the
 derived counters (imgs/sec, MFU, step percentiles), the training-health
 section (grad-norm / update-ratio trends, D real/fake accuracy, D/G
-loss-ratio EWMA with breach counts, non-finite triage events), and hang
+loss-ratio EWMA with breach counts, non-finite triage events), the
+"## quality" section (ISSUE 18: per-sweep FID/KID trend table,
+reference-store hit rate, regression-sentinel events), and hang
 dumps. ``--json`` includes every counter plus the full ``health`` block
 (health counter series, nonfinite events) — the machine-readable feed
 ``scripts/check_run_health.py`` gates on.

@@ -1,0 +1,368 @@
+"""Quality observability plane tests (ISSUE 18): the content-addressed
+reference-feature store (roundtrip, multi-writer, quarantine), the EWMA
+regression sentinel, the EvalPlane sweep schema, the check_run_health
+quality gates, the report "## quality" section — plus the PRDC
+hand-computed numpy reference the reference repo never had.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from imaginaire_tpu import telemetry
+from imaginaire_tpu.evaluation import (
+    EvalPlane,
+    FeatureStore,
+    RegressionSentinel,
+    evaluation_settings,
+    extractor_id,
+    make_patch_extractor,
+    prdc_from_activations,
+    reference_key,
+)
+from imaginaire_tpu.telemetry import core as tcore
+from imaginaire_tpu.telemetry.report import render_report, summarize
+
+
+@pytest.fixture
+def tm_sandbox():
+    old = tcore._TELEMETRY
+    yield
+    tcore._TELEMETRY.shutdown()
+    tcore._TELEMETRY = old
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+# ------------------------------------------------------------------ PRDC
+class TestPRDCReference:
+    """prdc_from_activations against a brute-force loop implementation
+    (Naeem et al. 2020 definitions, computed the slow obvious way)."""
+
+    @staticmethod
+    def _brute_force(real, fake, k):
+        def knn_radius(x, i):
+            d = sorted(np.linalg.norm(x[i] - x[j]) for j in range(len(x))
+                       if j != i)
+            return d[k - 1]
+
+        r_real = [knn_radius(real, i) for i in range(len(real))]
+        r_fake = [knn_radius(fake, j) for j in range(len(fake))]
+        d = np.array([[np.linalg.norm(r - f) for f in fake] for r in real])
+        precision = np.mean([(d[:, j] < r_real).any()
+                             for j in range(len(fake))])
+        recall = np.mean([(d[i, :] < r_fake).any()
+                          for i in range(len(real))])
+        density = np.mean([(d[:, j] < r_real).sum()
+                           for j in range(len(fake))]) / k
+        coverage = np.mean([d[i, :].min() < r_real[i]
+                            for i in range(len(real))])
+        return {"precision": float(precision), "recall": float(recall),
+                "density": float(density), "coverage": float(coverage)}
+
+    def test_matches_brute_force(self, rng):
+        real = rng.randn(24, 5)
+        fake = rng.randn(20, 5) * 1.3 + 0.4
+        want = self._brute_force(real, fake, k=3)
+        got = prdc_from_activations(real, fake, nearest_k=3)
+        for name in ("precision", "recall", "density", "coverage"):
+            assert got[name] == pytest.approx(want[name], abs=1e-12), name
+
+    def test_hand_computed_fixture(self):
+        """1-D points, k=1, small enough to verify by eye.
+
+        real = [0, 1, 10]; fake = [0.4, 20].
+        Real 1-NN radii: [1, 1, 9]. Fake 1-NN radii: [19.6, 19.6].
+        fake 0.4 is inside real balls at 0 and 1 (|d|=0.4,0.6 < 1);
+        fake 20 is inside none -> precision 1/2, density (2+0)/2/1 = 1.
+        Every real point is within 19.6 of a fake -> recall 1.
+        Real balls at 0 and 1 contain fake 0.4; the ball at 10
+        (radius 9) contains neither fake (9.6, 10) -> coverage 2/3."""
+        real = np.array([[0.0], [1.0], [10.0]])
+        fake = np.array([[0.4], [20.0]])
+        out = prdc_from_activations(real, fake, nearest_k=1)
+        assert out["precision"] == pytest.approx(0.5)
+        assert out["recall"] == pytest.approx(1.0)
+        assert out["density"] == pytest.approx(1.0)
+        assert out["coverage"] == pytest.approx(2.0 / 3.0)
+
+    def test_identical_sets_degenerate(self):
+        """real == fake with fewer points than the default k: the
+        nearest_k clamp must evaluate (not crash) and every identity
+        metric must saturate at 1. Density is NOT 1 even for identical
+        sets (ball membership is strict <): with k clamped to 2, radii
+        are [1, sqrt2, sqrt2] and the per-point membership counts are
+        3, 1, 1 -> density (3+1+1)/3/2 = 5/6."""
+        x = np.array([[0.0, 0.0], [1.0, 0.0], [0.0, 1.0]])
+        out = prdc_from_activations(x, x.copy(), nearest_k=5)
+        assert out["precision"] == pytest.approx(1.0)
+        assert out["recall"] == pytest.approx(1.0)
+        assert out["coverage"] == pytest.approx(1.0)
+        assert out["density"] == pytest.approx(5.0 / 6.0)
+
+
+# --------------------------------------------------------- feature store
+class TestFeatureStore:
+    def test_roundtrip_and_stats(self, tmp_path, rng):
+        store = FeatureStore(str(tmp_path))
+        key = reference_key("cityscapes", "inception-g2:w:1:2", "256x256")
+        acts = rng.randn(10, 16).astype(np.float32)
+        assert store.get(key) is None
+        store.put(key, acts, dataset="cityscapes")
+        got = store.get(key)
+        np.testing.assert_array_equal(got, acts)
+        s = store.stats()
+        assert s["hits"] == 1 and s["misses"] == 1
+        assert s["hit_rate"] == pytest.approx(0.5)
+
+    def test_key_sensitivity(self):
+        base = reference_key("ds", "ex", "256x256")
+        assert reference_key("ds", "ex", "256x256") == base
+        assert reference_key("ds2", "ex", "256x256") != base
+        assert reference_key("ds", "ex2", "256x256") != base
+        assert reference_key("ds", "ex", "128x128") != base
+        assert reference_key("ds", "ex", "256x256", max_batches=4) != base
+        assert reference_key("ds", "ex", (256, 256)) == base
+
+    def test_multi_writer_last_commit_wins_atomically(self, tmp_path, rng):
+        """Two writers racing the same key must both succeed and leave
+        exactly one intact shard (atomic os.replace, no partial file).
+        A second put of an existing key is a cheap no-op."""
+        a, b = FeatureStore(str(tmp_path)), FeatureStore(str(tmp_path))
+        key = reference_key("ds", "ex", "native")
+        acts = rng.randn(4, 8).astype(np.float32)
+        a.put(key, acts)
+        b.put(key, acts + 1.0)  # existence-skip: first commit stands
+        shard_dir = os.path.dirname(a.path(key))
+        files = [f for f in os.listdir(shard_dir) if f.endswith(".npz")]
+        assert len(files) == 1, files
+        np.testing.assert_array_equal(a.get(key), acts)
+
+    def test_quarantine_on_corrupt(self, tm_sandbox, tmp_path, rng):
+        tm = telemetry.configure(enabled=True, sinks=[],
+                                 flush_every_n_steps=0)
+        store = FeatureStore(str(tmp_path))
+        key = reference_key("ds", "ex", "native")
+        store.put(key, rng.randn(4, 8).astype(np.float32))
+        with open(store.path(key), "wb") as f:
+            f.write(b"not a zipfile")
+        assert store.get(key) is None  # quarantined, reads as a miss
+        assert not os.path.exists(store.path(key))
+        quarantined = [f for f in os.listdir(os.path.dirname(
+            store.path(key))) if f.endswith(".corrupt")]
+        assert len(quarantined) == 1, quarantined
+        assert store.stats()["corrupt_shards"] == 1
+        names = {e["name"] for e in tm._events}
+        assert "eval/store_corrupt" in names
+        # recompute path works again after quarantine
+        store.put(key, rng.randn(4, 8).astype(np.float32))
+        assert store.get(key) is not None
+
+    def test_extractor_id_shapes(self, tmp_path):
+        rid = extractor_id(random_init=True)
+        assert "random-init" in rid
+        wpath = tmp_path / "w.npz"
+        wpath.write_bytes(b"x" * 37)
+        wid = extractor_id(weights_path=str(wpath))
+        assert "w.npz" in wid and ":37:" in wid
+
+    def test_settings_defaults_and_parse(self):
+        s = evaluation_settings(None)
+        assert s["every_n_iter"] is None and s["store"] is True
+        assert s["extractor"] == "inception"
+        s2 = evaluation_settings({"evaluation": {
+            "every_n_iter": 50, "extractor": "patch", "metrics": ["fid"],
+            "regression_threshold": 0.3}})
+        assert s2["every_n_iter"] == 50
+        assert s2["extractor"] == "patch"
+        assert s2["regression_threshold"] == pytest.approx(0.3)
+
+
+# -------------------------------------------------------------- sentinel
+class TestRegressionSentinel:
+    def test_improving_series_never_fires(self):
+        s = RegressionSentinel(threshold=0.05, consecutive=2)
+        for v in [50.0, 40.0, 30.0, 25.0, 24.0]:
+            assert s.observe(v) is None
+        assert s.fired == 0
+
+    def test_single_spike_does_not_fire(self):
+        s = RegressionSentinel(threshold=0.2, consecutive=2, beta=0.5)
+        assert s.observe(10.0) is None
+        assert s.observe(20.0) is None  # breach 1 of 2
+        assert s.observe(10.0) is None  # recovered: streak resets
+        assert s.fired == 0
+
+    def test_persistent_degradation_fires_once(self, tm_sandbox):
+        """The leg_spade_eval numerics: [10, 20, 20, 20] with beta 0.5
+        fires exactly at the second consecutive breach, then the EWMA
+        adapts to the new plateau and the streak resets."""
+        tm = telemetry.configure(enabled=True, sinks=[],
+                                 flush_every_n_steps=0)
+        s = RegressionSentinel(threshold=0.2, consecutive=2, beta=0.5)
+        results = [s.observe(v, step=i)
+                   for i, v in enumerate([10.0, 20.0, 20.0, 20.0])]
+        assert results[0] is None and results[1] is None
+        assert results[2] is not None and results[2]["streak"] == 2
+        assert results[3] is None
+        assert s.fired == 1
+        metas = [e for e in tm._events if e["kind"] == "meta"
+                 and e["name"] == "eval/regression"]
+        assert len(metas) == 1 and metas[0]["metric"] == "fid"
+        ctrs = [e for e in tm._events if e["kind"] == "counter"
+                and e["name"] == "eval/regressions"]
+        assert ctrs and ctrs[-1]["value"] == 1.0
+
+
+# ----------------------------------------------------------- eval plane
+def _synthetic_loader(rng, batches=3, bs=4, hw=16):
+    return [{"images": rng.rand(bs, hw, hw, 3).astype(np.float32) * 2 - 1}
+            for _ in range(batches)]
+
+
+def _gen_fn(data):
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.asarray(data["images"])) * 0.5
+
+
+class TestEvalPlane:
+    def test_sweep_schema_and_store_warmup(self, tm_sandbox, tmp_path, rng):
+        tm = telemetry.configure(enabled=True, sinks=[],
+                                 flush_every_n_steps=0)
+        plane = EvalPlane(cfg={"evaluation": {"extractor": "patch"}},
+                          store_dir=str(tmp_path))
+        loader = _synthetic_loader(rng)
+        extractor = make_patch_extractor(grid=4)
+        kwargs = dict(dataset_name="synth", resolution="16x16",
+                      extractor_tag="patch-v1:g4")
+        r1 = plane.run_sweep(loader, "images", "fake_images", extractor,
+                             _gen_fn, step=10, **kwargs)
+        r2 = plane.run_sweep(loader, "images", "fake_images", extractor,
+                             _gen_fn, step=20, **kwargs)
+        assert not r1["ref_cache_hit"] and r2["ref_cache_hit"]
+        assert r1["fid"] == pytest.approx(r2["fid"], rel=1e-6)
+        assert r1["fid"] > 0 and np.isfinite(r1["fid"])
+        assert r2["sweep"] == 2
+        assert r1["time_to_fid_ms"] > 0
+        assert plane.store_stats()["hits"] == 1
+        ctr = {}
+        for e in tm._events:
+            if e["kind"] == "counter":
+                ctr.setdefault(e["name"], []).append(e["value"])
+        for name in ("eval/fid", "eval/time_to_fid_ms", "eval/batches"):
+            assert name in ctr, sorted(ctr)
+        assert ctr["eval/ref_cache_hit"] == [0.0, 1.0]
+        sweeps = [e for e in tm._events if e["kind"] == "meta"
+                  and e["name"] == "eval/sweep"]
+        assert len(sweeps) == 2 and sweeps[0]["dataset"] == "synth"
+
+    def test_kid_metric_optional(self, tm_sandbox, tmp_path, rng):
+        telemetry.configure(enabled=True, sinks=[], flush_every_n_steps=0)
+        plane = EvalPlane(cfg={"evaluation": {"extractor": "patch"}},
+                          store_dir=str(tmp_path))
+        r = plane.run_sweep(_synthetic_loader(rng), "images",
+                            "fake_images", make_patch_extractor(grid=4),
+                            _gen_fn, metrics=["fid", "kid"],
+                            extractor_tag="patch-v1:g4")
+        assert "kid" in r and np.isfinite(r["kid"])
+
+
+# ------------------------------------------------- gates + report render
+def _quality_events(fids, regressions=0, hits=(0, 1, 1)):
+    events = []
+    for i, fid in enumerate(fids):
+        step = (i + 1) * 100
+        events.append({"kind": "counter", "name": "eval/fid",
+                       "value": fid, "step": step, "t": 0.0})
+        events.append({"kind": "counter", "name": "eval/time_to_fid_ms",
+                       "value": 1000.0, "step": step, "t": 0.0})
+        events.append({"kind": "counter", "name": "eval/ref_cache_hit",
+                       "value": float(hits[i % len(hits)]), "step": step,
+                       "t": 0.0})
+        events.append({"kind": "meta", "name": "eval/sweep", "t": 0.0,
+                       "sweep": i + 1, "step": step, "fid": fid})
+    if regressions:
+        events.append({"kind": "counter", "name": "eval/regressions",
+                       "value": float(regressions), "step": step,
+                       "t": 0.0})
+        events.append({"kind": "meta", "name": "eval/regression",
+                       "t": 0.0, "metric": "fid", "step": step,
+                       "value": fids[-1], "baseline": fids[0],
+                       "delta": 0.5, "threshold": 0.05, "streak": 2})
+    return events
+
+
+class TestQualityGates:
+    def _check(self, events, **kw):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "check_run_health", os.path.join(
+                os.path.dirname(__file__), "..", "scripts",
+                "check_run_health.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.check_health(summarize(events), **kw)
+
+    def test_gates_absent_counters_pass(self):
+        # graph-gate idiom: a run that never evaluated passes untouched
+        assert self._check([], max_fid=1.0,
+                           max_quality_regressions=0) == []
+
+    def test_max_fid_gate(self):
+        events = _quality_events([30.0, 25.0, 40.0])
+        assert self._check(events, max_fid=50.0) == []
+        failures = self._check(events, max_fid=35.0)
+        assert len(failures) == 1 and "40" in failures[0]
+
+    def test_regression_gate(self):
+        clean = _quality_events([30.0, 25.0, 24.0])
+        assert self._check(clean, max_quality_regressions=0) == []
+        bad = _quality_events([30.0, 45.0, 50.0], regressions=1)
+        failures = self._check(bad, max_quality_regressions=0)
+        assert len(failures) == 1 and "regression" in failures[0]
+        assert self._check(bad, max_quality_regressions=1) == []
+
+    def test_report_quality_section(self):
+        events = _quality_events([30.0, 25.0, 40.0], regressions=1)
+        s = summarize(events)
+        q = s["quality"]
+        assert q["present"] and q["sweep_count"] == 3
+        assert q["fid_latest"] == pytest.approx(40.0)
+        assert q["fid_best"] == pytest.approx(25.0)
+        assert q["regressions"] == 1
+        assert q["ref_cache_hits"] == 2
+        text = render_report(events)
+        assert "## quality" in text
+        assert "!! quality regressions: 1" in text
+        assert "| sweep |" in text
+
+    def test_report_no_quality_section_when_absent(self):
+        assert "## quality" not in render_report(
+            [{"kind": "counter", "name": "loss/total", "value": 1.0,
+              "step": 1, "t": 0.0}])
+
+
+# ------------------------------------------------ instrumented activations
+class TestInstrumentedActivations:
+    def test_get_activations_spans_and_counter(self, tm_sandbox, rng):
+        from imaginaire_tpu.evaluation.common import get_activations
+
+        tm = telemetry.configure(enabled=True, sinks=[],
+                                 flush_every_n_steps=0)
+        acts = get_activations(_synthetic_loader(rng, batches=2), "images",
+                               "fake_images", make_patch_extractor(grid=4),
+                               generator_fn=_gen_fn)
+        assert acts.shape[0] == 8
+        spans = [e["name"] for e in tm._events if e["kind"] == "span"]
+        assert spans.count("eval_extract") == 2
+        assert spans.count("eval_generate") == 2
+        batches = [e for e in tm._events if e["kind"] == "counter"
+                   and e["name"] == "eval/batches"]
+        assert batches and batches[-1]["value"] == 2.0
