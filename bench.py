@@ -71,6 +71,7 @@ def _leg_summary(tm, xla_mark=None, trainer=None):
     out.update(_pipeline_leg(tm))
     out["pod"] = _pod_leg(tm)
     out["eval"] = _eval_leg(tm)
+    out["serving"] = _serving_leg(tm)
     return out
 
 
@@ -140,6 +141,35 @@ def _eval_leg(tm):
         "fid": fid,
         "time_to_fid_ms": ttf,
         "ref_cache_hit_rate": (sum(hits) / len(hits)) if hits else None,
+    }
+
+
+def _serving_leg(tm):
+    """{p50_ms, p99_ms, requests, bucket_hit_rate, pad_waste_frac} for
+    one bench leg (ISSUE 19) — the serving engine's latest SLO counters
+    when the leg pushed requests through the warm executable pool.
+    None for legs that never served."""
+    latest = {}
+    keep = ("serve/p50_ms", "serve/p99_ms", "serve/requests",
+            "serve/bucket_hit_rate", "serve/pad_waste_frac",
+            "serve/queue_depth")
+    try:
+        with tm._lock:
+            events = list(tm._events)
+        for ev in events:
+            if ev.get("kind") == "counter" and ev.get("name") in keep:
+                latest[ev["name"]] = ev.get("value")
+    except Exception:  # noqa: BLE001 — bench accounting is best-effort
+        pass
+    if not latest:
+        return None
+    return {
+        "p50_ms": latest.get("serve/p50_ms"),
+        "p99_ms": latest.get("serve/p99_ms"),
+        "requests": latest.get("serve/requests"),
+        "bucket_hit_rate": latest.get("serve/bucket_hit_rate"),
+        "pad_waste_frac": latest.get("serve/pad_waste_frac"),
+        "queue_depth": latest.get("serve/queue_depth"),
     }
 
 
@@ -641,6 +671,159 @@ def run_eval_ab(batches=8, bs=8, hw=(64, 64)):
         "metric": "eval_ref_store_warm_speedup_pct",
         "value": round(speedup_pct, 2),
         "unit": "pct",
+        "vs_baseline": None,
+    }))
+    return payload
+
+
+def _merge_servebench(extra):
+    """Merge keys into SERVEBENCH.json without clobbering existing rows."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "SERVEBENCH.json")
+    book = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            book = json.load(f)
+    book.update(extra)
+    with open(path, "w") as f:
+        json.dump(book, f, indent=1)
+
+
+def run_serving_ab(hw_buckets=((64, 64), (96, 96)), batch_sizes=(1, 4)):
+    """Serving cold-vs-warm A/B (ISSUE 19 acceptance record): the same
+    bucketed request trace driven through TWO ServingEngine pools —
+    cold (first request pays the jit compile, later buckets compile
+    mid-trace) and warm (``engine.warm()`` AOT-compiles the full
+    (bucket x batch-size) table first) — recording both legs' TTFI
+    (time-to-first-image), sustained p50/p99, bucket_hit_rate and
+    pad_waste_frac into SERVEBENCH.json. The tiny SPADE width keeps
+    the leg CPU-feasible; the speedup is compile-vs-dispatch, which
+    the width only scales in the cold leg's favor."""
+    import time as _time
+
+    import jax
+
+    from imaginaire_tpu.config import Config
+    from imaginaire_tpu.registry import resolve
+    from imaginaire_tpu.serving import ServeRequest, ServingEngine
+
+    tm = _bench_telemetry()
+    cfg = Config()
+    cfg.trainer.type = "imaginaire_tpu.trainers.spade"
+    cfg.trainer.gan_mode = "hinge"
+    cfg.trainer.loss_weight = {"gan": 1.0, "feature_matching": 10.0,
+                               "kl": 0.05, "perceptual": 10.0}
+    cfg.trainer.perceptual_loss = {
+        "mode": "vgg19", "layers": ["relu_1_1", "relu_2_1"],
+        "weights": [0.5, 1.0], "allow_random_init": True}
+    cfg.gen = {
+        "type": "imaginaire_tpu.models.generators.spade",
+        "style_dims": 16, "num_filters": 4, "kernel_size": 3,
+        "weight_norm_type": "spectral",
+        "global_adaptive_norm_type": "instance",
+        "activation_norm_params": {"num_filters": 4, "kernel_size": 3,
+                                   "activation_norm_type": "instance",
+                                   "weight_norm_type": "none",
+                                   "separate_projection": False},
+        "style_enc": {"num_filters": 4, "kernel_size": 3},
+    }
+    cfg.dis = {
+        "type": "imaginaire_tpu.models.discriminators.spade",
+        "num_filters": 4, "max_num_filters": 16, "num_discriminators": 2,
+        "num_layers": 2, "weight_norm_type": "spectral",
+    }
+    cfg.data = {
+        "name": "serve_bench", "type": "imaginaire_tpu.data.paired_images",
+        "input_types": [
+            {"images": {"num_channels": 3, "normalize": True}},
+            {"seg_maps": {"num_channels": 4, "is_mask": True,
+                          "use_dont_care": True,
+                          "interpolator": "NEAREST"}},
+        ],
+        "input_image": ["images"],
+        "input_labels": ["seg_maps"],
+        "train": {"batch_size": 1,
+                  "augmentations": {"random_crop_h_w": "256, 256"}},
+    }
+    cfg.serving.buckets = [list(hw) for hw in hw_buckets]
+    cfg.serving.batch_sizes = list(batch_sizes)
+
+    trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+    rng0 = np.random.RandomState(0)
+    h0, w0 = hw_buckets[0]
+    init_batch = {
+        "images": rng0.rand(1, h0, w0, 3).astype(np.float32) * 2 - 1,
+        "label": (rng0.rand(1, h0, w0, 5) > 0.8).astype(np.float32),
+    }
+    example = trainer.start_of_iteration(dict(init_batch), 0)
+
+    def req(rng, seed, hw):
+        h, w = hw
+        return ServeRequest(
+            data={"label": rng.rand(1, h, w, 5).astype(np.float32),
+                  "images": np.zeros((1, h, w, 3), np.float32)},
+            seed=seed)
+
+    # mixed trace: both buckets, full bs=4 chunks, bs=1 remainders and
+    # padded partials — the bucketing/padding story, not one hot lane
+    rounds = [(hw_buckets[0], 4), (hw_buckets[1], 2), (hw_buckets[0], 3),
+              (hw_buckets[1], 4), (hw_buckets[0], 1), (hw_buckets[1], 3),
+              (hw_buckets[0], 4), (hw_buckets[1], 1)]
+    n_requests = sum(k for _, k in rounds)
+
+    legs = {}
+    for leg in ("cold", "warm"):
+        engine = ServingEngine(cfg, trainer=trainer)
+        engine.register_example(example)
+        engine.initialize(example_batch=init_batch)
+        warm_s = None
+        if leg == "warm":
+            t0 = _time.perf_counter()
+            engine.warm()
+            warm_s = _time.perf_counter() - t0
+        rng = np.random.RandomState(19)
+        # TTFI: one bs=1 request; cold pays the jit compile here
+        t0 = _time.perf_counter()
+        engine.serve([req(rng, 0, hw_buckets[0])])
+        ttfi_ms = (_time.perf_counter() - t0) * 1e3
+        seed = 1
+        for hw, k in rounds:
+            batch = [req(rng, seed + i, hw) for i in range(k)]
+            seed += k
+            engine.serve(batch)
+        st = engine.stats()
+        legs[leg] = {
+            "ttfi_ms": round(ttfi_ms, 2),
+            "warm_table_s": round(warm_s, 2) if warm_s else None,
+            "p50_ms": round(st["p50_ms"], 2),
+            "p99_ms": round(st["p99_ms"], 2),
+            "bucket_hit_rate": st["bucket_hit_rate"],
+            "pad_waste_frac": round(st["pad_waste_frac"], 4),
+        }
+    speedup = legs["cold"]["ttfi_ms"] / max(legs["warm"]["ttfi_ms"], 1e-6)
+    assert speedup >= 5.0, (
+        f"warm pool must beat cold first-request compile >=5x, got "
+        f"{speedup:.1f}x ({legs})")
+    payload = {
+        "serving_warm_ttfi_ms": legs["warm"]["ttfi_ms"],
+        "serving_ab": {
+            "platform": jax.devices()[0].platform,
+            "devices": len(jax.devices()),
+            "width": "tiny-nf4",
+            "buckets": [f"{h}x{w}" for h, w in hw_buckets],
+            "batch_sizes": list(batch_sizes),
+            "requests": 1 + n_requests,
+            "cold": legs["cold"],
+            "warm": legs["warm"],
+            "warm_ttfi_speedup_x": round(speedup, 1),
+            "leg": _serving_leg(tm),
+        },
+    }
+    _merge_servebench(payload)
+    print(json.dumps({
+        "metric": "serving_warm_ttfi_speedup_x",
+        "value": round(speedup, 1),
+        "unit": "x",
         "vs_baseline": None,
     }))
     return payload
@@ -1785,6 +1968,13 @@ def main():
                              "content-addressed shard back -> "
                              "EVALBENCH.json eval_ab + "
                              "time_to_fid_warm_ms")
+    parser.add_argument("--serving-ab", action="store_true",
+                        help="serving cold-vs-warm A/B only (ISSUE 19): "
+                             "the same bucketed request trace through a "
+                             "cold executable pool (first request pays "
+                             "the compile) and an AOT-warmed one -> "
+                             "SERVEBENCH.json serving_ab + "
+                             "serving_warm_ttfi_ms")
     parser.add_argument("--pod-scaling", action="store_true",
                         help="run ONLY the pod-scaling legs (ISSUE 14): "
                              "imgs/s + frames/s at 1/2/3 localhost pod "
@@ -1801,6 +1991,9 @@ def main():
         return
     if args.pod_scaling:
         run_pod_scaling()
+        return
+    if args.serving_ab:
+        run_serving_ab()
         return
     if args.eval_ab:
         run_eval_ab()

@@ -523,6 +523,27 @@ def default_config():
             shard_update_state=True,
             enabled="auto",  # auto: active iff mesh_shape is set
         ),
+        # -- Production serving (serving/engine.py, ISSUE 19). The
+        # engine AOT-warms one ledgered executable per (bucket,
+        # batch_size); requests pad-and-bucket into the nearest one
+        # (padded lanes sliced off before return). buckets entries are
+        # [H, W] pairs inheriting the global knobs, or mappings
+        # {hw: [H, W], batch_sizes: [...], compute_dtype: bfloat16,
+        # remat: blocks, fused_modulation: auto} for per-bucket
+        # overrides (the ISSUE-9/15 memory levers, applied at serving
+        # granularity). queue_timeout_ms bounds how long a request may
+        # wait for batch-mates; max_queue is backpressure, not a goal.
+        serving=AttrDict(
+            families=["spade"],
+            buckets=[[256, 256]],
+            batch_sizes=[1, 4],
+            queue_timeout_ms=5.0,
+            max_queue=64,
+            compute_dtype=None,
+            remat=None,
+            max_executables=16,
+            seed=0,
+        ),
         # -- TPU runtime (replaces ref cudnn/local_rank blocks, config.py:143-150)
         runtime=AttrDict(
             mesh=AttrDict(axes=["data"], shape=None),  # shape None => all devices on 'data'

@@ -10,6 +10,22 @@ Reference ops (ref: imaginaire/third_party/):
                      materializes three full-size tensors the fused op
                      keeps out of HBM)
 
+canonical imports
+-----------------
+``from imaginaire_tpu.ops import resample2d`` binds the FUNCTION — and
+because the package also has a ``resample2d`` submodule, that name
+shadows the module everywhere (``import imaginaire_tpu.ops.resample2d``
+followed by ``imaginaire_tpu.ops.resample2d.AUTO_IMPLEMENTATION`` dies
+with "'function' object has no attribute ...": the package attribute
+won the race; this bit the memory autotuner once already). The rules:
+
+  - calling the op:      ``from imaginaire_tpu.ops import resample2d``
+  - module attributes:   ``from imaginaire_tpu.ops import resample2d_mod``
+    (every op exports an explicit ``<op>_mod`` alias; reach constants as
+    ``resample2d_mod.AUTO_IMPLEMENTATION``)
+  - NEVER ``import imaginaire_tpu.ops.resample2d`` and then dot through
+    ``imaginaire_tpu.ops.resample2d`` — you get the function.
+
 Each op has a pure-jnp implementation (differentiable; XLA autodiff turns
 the gather-style forward into the scatter-add backward the CUDA code does
 with atomicAdd) and a Pallas TPU kernel reachable via
@@ -45,10 +61,23 @@ backed by an OPSBENCH.json row, never asserted by fiat. To refresh:
      pin-vs-OPSBENCH consistency check passing.
 """
 
+# module aliases FIRST (while the package attributes still point at the
+# submodules), then the function imports that shadow them
+from imaginaire_tpu.ops import resample2d as resample2d_mod
+from imaginaire_tpu.ops import channelnorm as channelnorm_mod
+from imaginaire_tpu.ops import correlation as correlation_mod
+from imaginaire_tpu.ops import spade_modulation as spade_modulation_mod
 from imaginaire_tpu.ops.resample2d import resample2d
 from imaginaire_tpu.ops.channelnorm import channelnorm
 from imaginaire_tpu.ops.correlation import correlation
 from imaginaire_tpu.ops.spade_modulation import spade_modulation
+
+OP_MODULES = {
+    "resample2d": resample2d_mod,
+    "channelnorm": channelnorm_mod,
+    "correlation": correlation_mod,
+    "spade_modulation": spade_modulation_mod,
+}
 
 
 def resolved_implementations():
@@ -56,15 +85,10 @@ def resolved_implementations():
     to — the single source is each module's ``AUTO_IMPLEMENTATION``
     constant. Bench legs record this map so BENCH rows are attributable
     to kernel choices (ISSUE 16)."""
-    import importlib
-
-    return {
-        op: importlib.import_module(f"imaginaire_tpu.ops.{op}")
-        .AUTO_IMPLEMENTATION
-        for op in ("resample2d", "channelnorm", "correlation",
-                   "spade_modulation")
-    }
+    return {op: mod.AUTO_IMPLEMENTATION for op, mod in OP_MODULES.items()}
 
 
 __all__ = ["resample2d", "channelnorm", "correlation", "spade_modulation",
+           "resample2d_mod", "channelnorm_mod", "correlation_mod",
+           "spade_modulation_mod", "OP_MODULES",
            "resolved_implementations"]

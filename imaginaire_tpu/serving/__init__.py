@@ -1,0 +1,15 @@
+"""Production serving (ISSUE 19): AOT-warmed executable pool, bucketed
+micro-batching, and streaming vid2vid sessions. See ``engine.py``."""
+
+from imaginaire_tpu.serving.engine import (  # noqa: F401
+    BucketCfg,
+    ExecKey,
+    ExecutablePool,
+    RequestQueue,
+    ServeRequest,
+    ServingEngine,
+    ServingError,
+    StreamSession,
+    engine_from_config,
+    serving_settings,
+)

@@ -363,11 +363,43 @@ def summarize(events):
         "store_corrupt": int(
             counters.get("eval/store_corrupt", (0, None))[0] or 0),
     }
+    # serving SLO plane (ISSUE 19): top-level serve/* counters are the
+    # engine's cumulative request-latency percentiles and queue state;
+    # deeper serve/<family>/.../{p50_ms,p99_ms,count} names are the
+    # per-executable bucket series — check_run_health
+    # --max-p99-latency-ms / --max-queue-depth gate on the former
+    serve_buckets = {}
+    for name, (value, _) in counters.items():
+        m = str(name)
+        if not m.startswith("serve/"):
+            continue
+        label, _, stat = m.rpartition("/")
+        if stat in ("p50_ms", "p99_ms", "count") and \
+                label.count("/") >= 2:
+            serve_buckets.setdefault(label, {})[stat] = value
+    serving = {
+        "present": any(str(n).startswith("serve/") for n in counters)
+        or any(str(n).startswith("serve/") for n in meta),
+        "p50_ms": counters.get("serve/p50_ms", (None, None))[0],
+        "p99_ms": counters.get("serve/p99_ms", (None, None))[0],
+        "requests": int(counters.get("serve/requests", (0, None))[0]
+                        or 0),
+        "queue_depth": counters.get("serve/queue_depth",
+                                    (None, None))[0],
+        "bucket_hit_rate": counters.get("serve/bucket_hit_rate",
+                                        (None, None))[0],
+        "pad_waste_frac": counters.get("serve/pad_waste_frac",
+                                       (None, None))[0],
+        "hbm_headroom_frac": counters.get("serve/hbm_headroom_frac",
+                                          (None, None))[0],
+        "buckets": serve_buckets,
+        "weights_meta": meta.get("serve/weights"),
+    }
     return {"phases": table, "counters": counters, "meta": meta,
             "hangs": hangs, "wall_s": wall_s, "health": health,
             "flow_cache": flow_cache, "xla": xla,
             "resilience": resilience, "graph": graph, "pod": pod,
-            "quality": quality}
+            "quality": quality, "serving": serving}
 
 
 def _trend(series):
@@ -691,6 +723,49 @@ def _pod_section(s):
     return lines
 
 
+def _serving_section(s):
+    """Markdown lines for the serving SLO section (ISSUE 19): the
+    engine's request-latency percentiles, queue/bucketing efficiency,
+    and the per-executable bucket latency table. Empty when the run
+    served no requests."""
+    sv = s.get("serving") or {}
+    if not sv.get("present"):
+        return []
+    lines = ["", "## serving"]
+    if sv.get("p50_ms") is not None:
+        lines.append(
+            f"- request latency: p50 {sv['p50_ms']:.1f}ms, p99 "
+            f"{sv['p99_ms']:.1f}ms over {sv.get('requests', 0)} "
+            f"request(s)")
+    if sv.get("bucket_hit_rate") is not None:
+        lines.append(
+            f"- bucketing: hit rate "
+            f"{sv['bucket_hit_rate'] * 100:.0f}%, pad waste "
+            f"{(sv.get('pad_waste_frac') or 0) * 100:.1f}% of lanes, "
+            f"queue depth {sv.get('queue_depth') or 0:.0f}")
+    if sv.get("hbm_headroom_frac") is not None:
+        lines.append(f"- hbm headroom: "
+                     f"{sv['hbm_headroom_frac'] * 100:.0f}%")
+    wm = sv.get("weights_meta") or {}
+    if wm:
+        verified = wm.get("verified")
+        lines.append(f"- weights: {wm.get('checkpoint', '?')} "
+                     f"({'verified restore' if verified else '!! UNVERIFIED'})")
+    buckets = sv.get("buckets") or {}
+    if buckets:
+        lines.append("| executable | exec p50 ms | exec p99 ms | batches |")
+        lines.append("|---|---|---|---|")
+        for label in sorted(buckets):
+            b = buckets[label]
+            p50, p99 = b.get("p50_ms"), b.get("p99_ms")
+            lines.append(
+                f"| {label} "
+                f"| {f'{p50:.1f}' if p50 is not None else '-'} "
+                f"| {f'{p99:.1f}' if p99 is not None else '-'} "
+                f"| {int(b.get('count') or 0)} |")
+    return lines
+
+
 def render_report(path_or_events):
     """Markdown-ish report (the PROFILE.md table format) for a
     telemetry.jsonl path or a pre-loaded event list."""
@@ -740,6 +815,7 @@ def render_report(path_or_events):
     lines.extend(_elasticity_section(s))
     lines.extend(_quality_section(s))
     lines.extend(_pod_section(s))
+    lines.extend(_serving_section(s))
     if s["hangs"]:
         lines.append("")
         lines.append(f"!! {len(s['hangs'])} hang dump(s) recorded:")

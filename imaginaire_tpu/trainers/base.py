@@ -1487,6 +1487,21 @@ class BaseTrainer:
             self._ema_batch_stats = mean_stats
             self._ema_bn_recal_iter = self.current_iteration
 
+    def inference_forward(self, variables, data, rng,
+                          inference_args=None):
+        """One inference forward of net_G. Routed through the attached
+        serving engine when one is present (``ServingEngine.attach``) —
+        the one-shot entry points then inherit the ledgered warm
+        executables and serve/* SLO telemetry for free — else the
+        legacy eager apply (byte-for-byte the seed behavior)."""
+        engine = getattr(self, "_serving_engine", None)
+        if engine is not None:
+            return engine.forward(variables, data, rng,
+                                  inference_args=inference_args)
+        return self.net_G.apply(
+            variables, data, training=False, rngs={"noise": rng},
+            method=self.net_G.inference, **(inference_args or {}))
+
     def test(self, data_loader, output_dir, inference_args=None):
         """(ref: base.py:672-696)."""
         from imaginaire_tpu.utils.visualization import tensor2im, save_image_grid
@@ -1502,10 +1517,9 @@ class BaseTrainer:
             tm.heartbeat()
             data = self.start_of_iteration(data, current_iteration=-1)
             with tm.span("eval"):
-                images = self.net_G.apply(
-                    variables, data, training=False,
-                    rngs={"noise": jax.random.PRNGKey(it)},
-                    method=self.net_G.inference, **inference_args)
+                images = self.inference_forward(
+                    variables, data, jax.random.PRNGKey(it),
+                    inference_args=inference_args)
             keys = data.get("key", [f"{it:06d}_{i}" for i in range(images.shape[0])])
             if isinstance(keys, (str, bytes)):
                 keys = [keys]

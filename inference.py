@@ -33,6 +33,10 @@ def parse_args():
     parser.add_argument("--output_dir", required=True)
     parser.add_argument("--logdir", default=None)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--no-serving-engine", action="store_true",
+                        help="Run the legacy eager test loop instead of "
+                             "routing through the serving engine's "
+                             "ledgered executables.")
     return parser.parse_args()
 
 
@@ -74,6 +78,18 @@ def main():
 
     trainer.current_epoch = -1
     trainer.current_iteration = -1
+    if not args.no_serving_engine:
+        # route the test loop through the serving engine (ISSUE 19):
+        # the forward compiles once into the ledgered executable pool
+        # (recompile tripwire armed) and every batch lands serve/*
+        # SLO counters in the same telemetry jsonl. Outputs are the
+        # jitted legacy computation — same weights, same noise keys.
+        from imaginaire_tpu.serving import ServingEngine
+
+        engine = ServingEngine(cfg, trainer=trainer, logdir=logdir)
+        engine.register_example(sample)
+        engine.refresh_weights()
+        engine.attach()
     inference_args = cfg_get(cfg, "inference_args", None)
     trainer.test(test_loader, args.output_dir,
                  dict(inference_args) if inference_args else None)

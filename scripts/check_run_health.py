@@ -27,6 +27,10 @@ Checks (each can fail the gate):
   ``--max-fid`` and regression-sentinel firings beyond
   ``--max-quality-regressions`` (pass 0 — a model that got worse and
   stayed worse fails CI like a slow step does). Runs without eval
+  counters pass;
+- serving SLOs (ISSUE 19): request-latency p99 beyond
+  ``--max-p99-latency-ms`` and queue depth beyond ``--max-queue-depth``
+  (serve/* counters from the serving engine). Runs without serve/*
   counters pass.
 
 Multi-host pods (ISSUE 8): every process writes its own
@@ -66,7 +70,8 @@ def check_health(summary, require_health=False, max_dg_breaches=0,
                  max_resizes=None, min_world_size=None,
                  max_step_skew_ms=None, max_divergence=None,
                  max_straggler_share=None, max_fid=None,
-                 max_quality_regressions=None):
+                 max_quality_regressions=None,
+                 max_p99_latency_ms=None, max_queue_depth=None):
     """Return the list of failure strings for an aggregated summary."""
     failures = []
     health = summary.get("health") or {}
@@ -269,6 +274,29 @@ def check_health(summary, require_health=False, max_dg_breaches=0,
                 f"{max_quality_regressions})"
                 + (f": {deltas[:3]}" if deltas else "")
                 + " — the model got worse and stayed worse")
+    # Serving SLO gates (ISSUE 19): the engine's cumulative request
+    # latency p99 against --max-p99-latency-ms and the queue's last
+    # observed depth against --max-queue-depth (a persistently deep
+    # queue means the warm pool can't keep up — that's a capacity
+    # failure, not a latency blip). Only runs that carried serve/*
+    # counters are gated (graph-gate idiom): a training run passes
+    # unchanged.
+    serving = summary.get("serving") or {}
+    if serving.get("present"):
+        p99 = serving.get("p99_ms")
+        if max_p99_latency_ms is not None and p99 is not None \
+                and p99 > max_p99_latency_ms:
+            failures.append(
+                f"serving p99 latency {p99:.1f}ms exceeds "
+                f"--max-p99-latency-ms {max_p99_latency_ms:g} "
+                f"(p50 {serving.get('p50_ms'):.1f}ms over "
+                f"{serving.get('requests', 0)} request(s))")
+        depth = serving.get("queue_depth")
+        if max_queue_depth is not None and depth is not None \
+                and depth > max_queue_depth:
+            failures.append(
+                f"serving queue depth {depth:.0f} exceeds "
+                f"--max-queue-depth {max_queue_depth:g}")
     if require_health and not health.get("has_health_counters"):
         failures.append(
             "no health/* counters in the run (diagnostics disabled or "
@@ -366,6 +394,16 @@ def main(argv=None):
                          "the EWMA trend past threshold for K "
                          "consecutive sweeps; pass 0 to fail on any. "
                          "Default: no regression gate)")
+    ap.add_argument("--max-p99-latency-ms", type=float, default=None,
+                    help="fail when the serving engine's request "
+                         "latency p99 (serve/p99_ms counter) exceeds "
+                         "this (default: no SLO gate; runs without "
+                         "serve/* counters pass)")
+    ap.add_argument("--max-queue-depth", type=float, default=None,
+                    help="fail when the serving queue's last observed "
+                         "depth (serve/queue_depth counter) exceeds "
+                         "this (default: no queue gate; runs without "
+                         "serve/* counters pass)")
     ap.add_argument("--hosts", action="store_true",
                     help="aggregate every per-process telemetry file "
                          "(telemetry.jsonl + telemetry.jsonl.p*) of a "
@@ -402,7 +440,9 @@ def main(argv=None):
                             max_straggler_share=args.max_straggler_share,
                             max_fid=args.max_fid,
                             max_quality_regressions=
-                            args.max_quality_regressions)
+                            args.max_quality_regressions,
+                            max_p99_latency_ms=args.max_p99_latency_ms,
+                            max_queue_depth=args.max_queue_depth)
     health = summary.get("health") or {}
     xla = summary.get("xla") or {}
     res = summary.get("resilience") or {}
@@ -467,6 +507,20 @@ def main(argv=None):
                 "ref_cache_hits": (summary.get("quality") or {}).get(
                     "ref_cache_hits", 0),
             },
+            "serving": {
+                "present": (summary.get("serving") or {}).get(
+                    "present", False),
+                "p50_ms": (summary.get("serving") or {}).get("p50_ms"),
+                "p99_ms": (summary.get("serving") or {}).get("p99_ms"),
+                "requests": (summary.get("serving") or {}).get(
+                    "requests", 0),
+                "queue_depth": (summary.get("serving") or {}).get(
+                    "queue_depth"),
+                "bucket_hit_rate": (summary.get("serving") or {}).get(
+                    "bucket_hit_rate"),
+                "pad_waste_frac": (summary.get("serving") or {}).get(
+                    "pad_waste_frac"),
+            },
         }, indent=1, default=str))
     elif failures:
         for failure in failures:
@@ -513,7 +567,10 @@ def _main_hosts(args):
                                 args.max_straggler_share,
                                 max_fid=args.max_fid,
                                 max_quality_regressions=
-                                args.max_quality_regressions)
+                                args.max_quality_regressions,
+                                max_p99_latency_ms=
+                                args.max_p99_latency_ms,
+                                max_queue_depth=args.max_queue_depth)
         verdicts[label] = {"path": fpath, "healthy": not failures,
                            "failures": failures}
         any_fail = any_fail or bool(failures)

@@ -35,6 +35,10 @@ def parse_args():
                         help="Optional style image for VAE-style encoders.")
     parser.add_argument("--output", required=True)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--no-serving-engine", action="store_true",
+                        help="Legacy eager forward instead of the "
+                             "serving engine's ledgered bs=1 "
+                             "executable.")
     return parser.parse_args()
 
 
@@ -117,11 +121,20 @@ def main():
         print("WARNING: no --checkpoint given; using fresh weights.")
 
     variables = trainer.inference_params()
-    net_G = trainer.net_G
     inference_args = dict(cfg_get(cfg, "inference_args", None) or {})
-    out = net_G.apply(variables, data, method="inference",
-                      rngs={"noise": jax.random.PRNGKey(args.seed)},
-                      **inference_args)
+    if not args.no_serving_engine:
+        # one-shot requests ride the serving engine's bs=1 bucket
+        # (ISSUE 19): the forward compiles into the ledgered pool and
+        # serve/* SLO counters land in the telemetry jsonl
+        from imaginaire_tpu.serving import ServingEngine
+
+        engine = ServingEngine(cfg, trainer=trainer)
+        engine.register_example(data)
+        engine.refresh_weights()
+        engine.attach()
+    out = trainer.inference_forward(
+        variables, data, jax.random.PRNGKey(args.seed),
+        inference_args=inference_args)
     fake = out["fake_images"] if isinstance(out, dict) else out
     from PIL import Image
 

@@ -1,0 +1,49 @@
+"""Regression: the ops package's function exports shadow its submodules
+(ISSUE 19 satellite; this bit the memory autotuner). ``<op>_mod``
+aliases are the canonical module handles."""
+
+import importlib
+import inspect
+
+import pytest
+
+OPS = ("resample2d", "channelnorm", "correlation", "spade_modulation")
+
+
+def test_function_import_shadows_submodule():
+    """The historical trap, pinned so nobody 'fixes' the docs away:
+    the package attribute named after the op IS the function."""
+    import imaginaire_tpu.ops as ops
+
+    for op in OPS:
+        assert inspect.isfunction(getattr(ops, op)), op
+
+
+@pytest.mark.parametrize("op", OPS)
+def test_mod_alias_is_the_submodule(op):
+    import imaginaire_tpu.ops as ops
+
+    alias = getattr(ops, f"{op}_mod")
+    assert inspect.ismodule(alias), f"{op}_mod is not a module"
+    assert alias is importlib.import_module(f"imaginaire_tpu.ops.{op}")
+    # the attribute the autotuner needed when the shadowing bit it
+    assert isinstance(alias.AUTO_IMPLEMENTATION, str)
+    # and the function the alias carries is the exported one
+    assert getattr(alias, op) is getattr(ops, op)
+
+
+def test_op_modules_table_matches_aliases():
+    import imaginaire_tpu.ops as ops
+
+    assert set(ops.OP_MODULES) == set(OPS)
+    for op, mod in ops.OP_MODULES.items():
+        assert mod is getattr(ops, f"{op}_mod")
+
+
+def test_resolved_implementations_uses_modules():
+    from imaginaire_tpu.ops import OP_MODULES, resolved_implementations
+
+    resolved = resolved_implementations()
+    assert set(resolved) == set(OPS)
+    for op, impl in resolved.items():
+        assert impl == OP_MODULES[op].AUTO_IMPLEMENTATION

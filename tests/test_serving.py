@@ -1,0 +1,512 @@
+"""Serving engine (ISSUE 19): pool keying/eviction, pad-and-slice
+bit-parity vs unpadded singles, stream ring-buffer continuity,
+verified-restore refusal, entry-point forward parity, and the SLO
+gates."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import __graft_entry__ as ge  # noqa: E402
+from imaginaire_tpu import telemetry  # noqa: E402
+from imaginaire_tpu.registry import resolve  # noqa: E402
+from imaginaire_tpu.serving import (  # noqa: E402
+    ExecKey,
+    ExecutablePool,
+    ServeRequest,
+    ServingEngine,
+    ServingError,
+    StreamSession,
+    serving_settings,
+)
+from scripts.check_run_health import check_health  # noqa: E402
+
+H = W = 64
+LABELS = 5
+
+
+def _mk_request(seed, h=H, w=W):
+    rng = np.random.RandomState(seed)
+    return ServeRequest(
+        data={"label": rng.rand(1, h, w, LABELS).astype(np.float32),
+              "images": np.zeros((1, h, w, 3), np.float32)},
+        seed=seed)
+
+
+@pytest.fixture(scope="module")
+def spade_engine(tmp_path_factory):
+    """One tiny SPADE trainer + engine shared by the module (compiles
+    are the expensive part; every test uses distinct request content)."""
+    telemetry.configure(enabled=True, sinks=[], flush_every_n_steps=0,
+                        mfu=False)
+    cfg = ge._tiny_cfg()
+    cfg.logdir = str(tmp_path_factory.mktemp("serve_logs"))
+    cfg.serving.buckets = [[H, W]]
+    cfg.serving.batch_sizes = [1, 4]
+    batch = ge._tiny_batch(1, h=H, w=W, labels=LABELS)
+    trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+    engine = ServingEngine(cfg, trainer=trainer)
+    engine.register_example(trainer.start_of_iteration(batch, 0))
+    engine.initialize(example_batch=batch)
+    return engine
+
+
+# ------------------------------------------------------------ settings
+
+
+def test_serving_settings_defaults():
+    from imaginaire_tpu.config import Config
+
+    s = serving_settings(Config())
+    assert s["families"] == ["spade"]
+    assert s["buckets"][0].hw == (256, 256)
+    assert s["buckets"][0].batch_sizes == (1, 4)
+    assert s["queue_timeout_ms"] == 5.0
+
+
+def test_serving_settings_per_bucket_overrides():
+    cfg = {"serving": {
+        "buckets": [[128, 128],
+                    {"hw": [512, 512], "batch_sizes": [1, 2],
+                     "compute_dtype": "bfloat16", "remat": "blocks"}],
+        "batch_sizes": [1, 8]}}
+    s = serving_settings(cfg)
+    b128, b512 = s["buckets"]
+    assert b128.batch_sizes == (1, 8) and b128.compute_dtype is None
+    assert b512.batch_sizes == (1, 2)
+    assert b512.compute_dtype == "bfloat16" and b512.remat == "blocks"
+
+
+def test_exec_key_labels():
+    assert ExecKey("spade", 256, 256, 4).label == "serve/spade/256x256/bs4"
+    assert ExecKey("spade", 256, 256, 1, tag="batch").label == \
+        "serve/spade/batch/256x256/bs1"
+    assert ExecKey("fs_vid2vid", 512, 256, 1, tag="stream").label == \
+        "serve/fs_vid2vid/stream/512x256/bs1"
+    assert ExecKey("spade", 512, 512, 2, compute_dtype="bfloat16",
+                   remat="blocks").label == \
+        "serve/spade/512x512/bs2/bfloat16/remat-blocks"
+
+
+# ---------------------------------------------------------------- pool
+
+
+def test_pool_keying_and_lru_eviction():
+    built = []
+
+    def build(key):
+        built.append(key)
+        return lambda *a: key.batch_size
+
+    pool = ExecutablePool(build, max_entries=2)
+    k1 = ExecKey("spade", 64, 64, 1)
+    k2 = ExecKey("spade", 64, 64, 4)
+    k3 = ExecKey("spade", 128, 128, 1)
+    p1 = pool.get(k1)
+    assert pool.get(k1) is p1  # hit: same CompiledProgram object
+    pool.get(k2)
+    assert len(built) == 2 and len(pool) == 2
+    pool.get(k1)  # refresh k1 -> k2 becomes LRU
+    pool.get(k3)  # evicts k2
+    assert pool.evictions == 1
+    assert k2 not in pool and k1 in pool and k3 in pool
+    # re-admitting the evicted key is a fresh build
+    pool.get(k2)
+    assert built.count(k2) == 2
+
+
+def test_pool_distinct_keys_per_knob():
+    ks = {ExecKey("spade", 64, 64, 1),
+          ExecKey("spade", 64, 64, 1, compute_dtype="bfloat16"),
+          ExecKey("spade", 64, 64, 1, remat="blocks"),
+          ExecKey("spade", 64, 64, 1, tag="batch"),
+          ExecKey("spade", 64, 64, 4)}
+    assert len(ks) == 5
+
+
+# ------------------------------------------------- pad-slice bit-parity
+
+
+def test_warm_pool_then_serve_no_recompiles(spade_engine):
+    from imaginaire_tpu.telemetry import xla_obs
+
+    report = spade_engine.warm()
+    assert set(report) >= {"serve/spade/64x64/bs1",
+                           "serve/spade/64x64/bs4"}
+    mark = xla_obs.snapshot_delta()
+    outs = spade_engine.serve([_mk_request(s) for s in range(3)])
+    assert len(outs) == 3
+    delta = xla_obs.snapshot_delta(mark)
+    assert not delta.get("compiles"), \
+        f"serving after warm() recompiled: {delta}"
+
+
+def test_padded_batch_bit_identical_to_unpadded(spade_engine):
+    """Padding correctness: zero pad lanes can NEVER contaminate real
+    lanes. The same 3 requests served in a full unpadded bs=4 batch
+    and in a padded 3+1 chunk (same executable) produce bit-identical
+    real-lane outputs — the vmapped per-lane program with per-request
+    noise keys makes each lane's graph independent of its batch-mates."""
+    spade_engine.warm()
+    # full unpadded batch: requests 100..103 fill bs=4 exactly
+    full = spade_engine.serve([_mk_request(100 + i) for i in range(4)])
+    # padded: the same first 3 requests -> one bs=4 chunk, 1 zero lane
+    padded = spade_engine.serve([_mk_request(100 + i) for i in range(3)])
+    for i, (a, b) in enumerate(zip(full[:3], padded)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            f"lane {i}: pad lane contaminated a real lane"
+    assert spade_engine.stats()["pad_waste_frac"] > 0
+
+
+def test_padded_chunk_matches_bs1_singles_across_executables(
+        spade_engine):
+    """Cross-executable (bs=4 program vs bs=1 program) the per-lane
+    math is identical — per-request noise keys make the draw
+    batch-size-invariant — but XLA:CPU under the test harness's
+    8-virtual-device thread partitioning schedules float reductions
+    differently per program, so the cross-program comparison is
+    allclose-tight rather than bitwise (bitwise on deterministic
+    backends)."""
+    spade_engine.warm()
+    padded = spade_engine.serve([_mk_request(300 + i) for i in range(3)])
+    for i in range(3):
+        spade_engine.submit(_mk_request(300 + i))
+        (single,) = spade_engine.flush().values()
+        np.testing.assert_allclose(np.asarray(padded[i]),
+                                   np.asarray(single), atol=2e-5)
+
+
+def test_slices_match_request_count_and_order(spade_engine):
+    spade_engine.warm()
+    reqs = [_mk_request(200 + i) for i in range(5)]  # 4 + 1(pad to 4)
+    outs = spade_engine.serve(reqs)
+    assert len(outs) == 5
+    assert all(o.shape == (H, W, 3) for o in outs)
+    # order: serving the same requests again individually matches 1:1
+    # (allclose across executables — see the cross-executable test)
+    for i, req in enumerate(reqs):
+        spade_engine.submit(_mk_request(200 + i))
+        (single,) = spade_engine.flush().values()
+        np.testing.assert_allclose(np.asarray(outs[i]),
+                                   np.asarray(single), atol=2e-5)
+
+
+# ---------------------------------------------------------------- queue
+
+
+def test_queue_overflow_backpressure(spade_engine):
+    q = spade_engine.queue
+    old = q.max_depth
+    q.max_depth = 2
+    try:
+        spade_engine.submit(_mk_request(1))
+        spade_engine.submit(_mk_request(2))
+        with pytest.raises(ServingError, match="queue overflow"):
+            spade_engine.submit(_mk_request(3))
+    finally:
+        q.max_depth = old
+        q.drain()
+
+
+def test_queue_due_on_full_batch_or_timeout(spade_engine):
+    q = spade_engine.queue
+    q.drain()
+    assert not q.due()
+    t0 = spade_engine.queue._pending  # noqa: F841
+    spade_engine.submit(_mk_request(1))
+    now = spade_engine.queue._pending[0].t_submit
+    assert not q.due(now=now)  # fresh single: wait for batch-mates
+    assert q.due(now=now + (q.timeout_ms + 1) / 1e3)  # timed out
+    for s in range(2, 5):
+        spade_engine.submit(_mk_request(s))
+    assert q.due(now=now)  # full bs=4 batch ready immediately
+    q.drain()
+
+
+# ----------------------------------------------- inference.py seam
+
+
+def test_forward_byte_identical_to_jitted_legacy(spade_engine):
+    """The satellite-2 parity contract: the engine's batch-tag program
+    IS the legacy test-loop computation, jitted — outputs are
+    byte-identical to jax.jit of the legacy apply. (Eager-vs-jit is
+    NOT bit-stable on XLA:CPU, so the reference is the jitted legacy
+    fn — same HLO, same bytes.)"""
+    import jax
+
+    trainer = spade_engine.trainer
+    variables = trainer.inference_params()
+    batch = ge._tiny_batch(1, h=H, w=W, labels=LABELS)
+    data = trainer.start_of_iteration(batch, 0)
+    rng = jax.random.PRNGKey(7)
+
+    net = trainer.net_G
+    legacy = jax.jit(lambda v, d, k: net.apply(
+        v, d, training=False, rngs={"noise": k}, method=net.inference))
+    from imaginaire_tpu.utils.misc import numeric_only
+
+    want = np.asarray(legacy(variables, numeric_only(dict(data)), rng))
+    got = np.asarray(spade_engine.forward(variables, data, rng))
+    assert np.array_equal(want, got)
+
+
+def test_trainer_inference_forward_routes_through_engine(spade_engine):
+    import jax
+
+    trainer = spade_engine.trainer
+    variables = trainer.inference_params()
+    batch = ge._tiny_batch(1, h=H, w=W, labels=LABELS)
+    data = trainer.start_of_iteration(batch, 0)
+    rng = jax.random.PRNGKey(3)
+    # legacy seam (no engine attached): eager apply
+    trainer._serving_engine = None
+    eager = np.asarray(trainer.inference_forward(variables, data, rng))
+    # attached: routed through the pooled executable
+    spade_engine.attach()
+    try:
+        served = np.asarray(trainer.inference_forward(variables, data,
+                                                      rng))
+    finally:
+        trainer._serving_engine = None
+    # same computation modulo jit-vs-eager float scheduling
+    np.testing.assert_allclose(eager, served, atol=1e-5)
+
+
+# ------------------------------------------------------ stream sessions
+
+
+class _StubV2VTrainer:
+    """Frame-recurrent trainer stub: enough surface for StreamSession
+    (_get_data_t/_apply_G/inference_params) with arithmetic simple
+    enough to assert ring-buffer continuity exactly."""
+
+    num_frames_G = 3
+    state = {"vars_G": {"params": {}}}
+    net_G = None
+
+    def inference_params(self):
+        return {"params": {}}
+
+    def _start_of_iteration(self, data, it):
+        return data
+
+    def _get_data_t(self, data, t, prev_labels, prev_images):
+        return {"label": data["label"], "prev_labels": prev_labels,
+                "prev_images": prev_images}
+
+    def _apply_G(self, vars_G, data_t, rng, training=False):
+        import jax.numpy as jnp
+
+        out = 2.0 * data_t["label"][..., :3]
+        prev = data_t["prev_images"]
+        if prev is not None:
+            out = out + 0.5 * jnp.sum(prev, axis=1)  # (B,T,H,W,C) -> (B,H,W,C)
+        return {"fake_images": out}, {}
+
+
+@pytest.fixture()
+def stream_engine():
+    telemetry.configure(enabled=True, sinks=[], flush_every_n_steps=0,
+                        mfu=False)
+    cfg = ge._tiny_cfg()
+    cfg.serving.buckets = [[H, W]]
+    return ServingEngine(cfg, trainer=_StubV2VTrainer(),
+                         family="fs_vid2vid")
+
+
+def _frame(value):
+    return {"label": np.full((1, H, W, 3), value, np.float32)}
+
+
+def test_stream_ring_buffer_continuity(stream_engine):
+    """Frame t+1 conditions on frame t's DEVICE-resident output: the
+    stub makes the recurrence exactly predictable."""
+    import jax
+
+    sess = stream_engine.stream("camA")
+    f0 = sess.step(_frame(1.0))  # 2*1
+    assert np.allclose(f0, 2.0)
+    assert sess.t == 1 and sess.prev_images is not None
+    # ring holds DEVICE arrays — no host re-upload between frames
+    assert isinstance(sess.prev_images, jax.Array)
+    f1 = sess.step(_frame(1.0))  # 2*1 + 0.5*sum([2.0])
+    assert np.allclose(f1, 3.0)
+    f2 = sess.step(_frame(1.0))  # 2 + 0.5*(2+3)
+    assert np.allclose(f2, 4.5)
+    # history caps at num_frames_G - 1 = 2 frames
+    f3 = sess.step(_frame(1.0))  # 2 + 0.5*(3+4.5) — frame 0 aged out
+    assert np.allclose(f3, 5.75)
+    assert sess.prev_images.shape[1] == 2
+
+
+def test_stream_sessions_are_isolated(stream_engine):
+    a = stream_engine.stream("camA")
+    b = stream_engine.stream("camB")
+    a.step(_frame(1.0))
+    # camB's first frame sees NO history even though camA ran
+    fb = b.step(_frame(1.0))
+    assert np.allclose(fb, 2.0)
+    assert b.t == 1 and a.t == 1
+    assert stream_engine.stream("camA") is a
+    a.reset()
+    assert a.t == 0 and a.prev_images is None
+
+
+def test_stream_requires_frame_recurrent_family(spade_engine):
+    with pytest.raises(ServingError, match="frame-recurrent"):
+        StreamSession(spade_engine, "s0")
+
+
+# ------------------------------------------------- verified restore
+
+
+def test_load_weights_refuses_without_checkpoint(spade_engine):
+    with pytest.raises(ServingError, match="no verifiable checkpoint"):
+        spade_engine.load_weights()
+    assert spade_engine.stats()["verified_restore"] is False
+    # smoke-test override stays available
+    assert spade_engine.load_weights(require=False) is False
+
+
+def test_load_weights_refuses_corrupt_checkpoint(tmp_path):
+    """Serving never deserializes what training would quarantine: a
+    byte-flipped checkpoint raises instead of restoring."""
+    telemetry.configure(enabled=True, sinks=[], flush_every_n_steps=0,
+                        mfu=False)
+    cfg = ge._tiny_cfg()
+    cfg.logdir = str(tmp_path)
+    batch = ge._tiny_batch(1, h=H, w=W, labels=LABELS)
+    trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+    engine = ServingEngine(cfg, trainer=trainer)
+    engine.register_example(trainer.start_of_iteration(batch, 0))
+    engine.initialize(example_batch=batch)
+    path = trainer.save_checkpoint(0, 1)
+    # flip bytes in the checkpoint payload
+    victims = []
+    for root, _, files in os.walk(str(path)) if os.path.isdir(str(path)) \
+            else [(os.path.dirname(str(path)), None,
+                   [os.path.basename(str(path))])]:
+        for f in files:
+            fp = os.path.join(root, f)
+            if os.path.getsize(fp) > 256:
+                victims.append(fp)
+    assert victims, "no checkpoint payload files found to corrupt"
+    for fp in victims:
+        with open(fp, "r+b") as fh:
+            fh.seek(128)
+            chunk = fh.read(64)
+            fh.seek(128)
+            fh.write(bytes(b ^ 0xFF for b in chunk))
+    with pytest.raises(Exception):
+        engine.load_weights(checkpoint=str(path))
+    assert engine.stats()["verified_restore"] is False
+
+
+def test_load_weights_verified_restore(tmp_path):
+    telemetry.configure(enabled=True, sinks=[], flush_every_n_steps=0,
+                        mfu=False)
+    cfg = ge._tiny_cfg()
+    cfg.logdir = str(tmp_path)
+    batch = ge._tiny_batch(1, h=H, w=W, labels=LABELS)
+    trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+    engine = ServingEngine(cfg, trainer=trainer)
+    engine.register_example(trainer.start_of_iteration(batch, 0))
+    engine.initialize(example_batch=batch)
+    trainer.save_checkpoint(0, 1)
+    assert engine.load_weights() is True
+    assert engine.stats()["verified_restore"] is True
+
+
+# ------------------------------------------------------------ SLO gates
+
+
+def _serving_summary(p99=42.0, depth=0.0):
+    return {"serving": {"present": True, "p50_ms": 10.0, "p99_ms": p99,
+                        "requests": 100, "queue_depth": depth,
+                        "bucket_hit_rate": 1.0, "pad_waste_frac": 0.1}}
+
+
+def test_slo_gate_pass():
+    assert check_health(_serving_summary(), max_p99_latency_ms=100,
+                        max_queue_depth=4) == []
+
+
+def test_slo_gate_p99_fail():
+    failures = check_health(_serving_summary(p99=250.0),
+                            max_p99_latency_ms=100)
+    assert any("p99 latency" in f for f in failures)
+
+
+def test_slo_gate_queue_depth_fail():
+    failures = check_health(_serving_summary(depth=9),
+                            max_queue_depth=4)
+    assert any("queue depth" in f for f in failures)
+
+
+def test_slo_gate_graph_gated_without_serving_counters():
+    """Runs without serve/* counters pass unchanged even with the
+    gates armed (the graph-gate idiom)."""
+    assert check_health({"serving": {"present": False}},
+                        max_p99_latency_ms=0.001,
+                        max_queue_depth=0) == []
+    assert check_health({}, max_p99_latency_ms=0.001,
+                        max_queue_depth=0) == []
+
+
+# ------------------------------------------------------ report section
+
+
+def test_report_serving_section_renders():
+    from imaginaire_tpu.telemetry.report import (
+        _serving_section,
+        summarize,
+    )
+
+    events = [
+        {"kind": "counter", "t": 1.0, "name": "serve/p50_ms",
+         "value": 11.0, "step": 1},
+        {"kind": "counter", "t": 1.0, "name": "serve/p99_ms",
+         "value": 20.5, "step": 1},
+        {"kind": "counter", "t": 1.0, "name": "serve/requests",
+         "value": 8, "step": 1},
+        {"kind": "counter", "t": 1.0, "name": "serve/queue_depth",
+         "value": 0, "step": 1},
+        {"kind": "counter", "t": 1.0, "name": "serve/bucket_hit_rate",
+         "value": 0.75, "step": 1},
+        {"kind": "counter", "t": 1.0, "name": "serve/pad_waste_frac",
+         "value": 0.125, "step": 1},
+        {"kind": "counter", "t": 1.0,
+         "name": "serve/spade/256x256/bs4/p50_ms", "value": 9.0,
+         "step": 1},
+        {"kind": "counter", "t": 1.0,
+         "name": "serve/spade/256x256/bs4/p99_ms", "value": 12.0,
+         "step": 1},
+        {"kind": "counter", "t": 1.0,
+         "name": "serve/spade/256x256/bs4/count", "value": 2, "step": 1},
+    ]
+    s = summarize(events)
+    sv = s["serving"]
+    assert sv["present"] and sv["p99_ms"] == 20.5
+    assert sv["buckets"]["serve/spade/256x256/bs4"]["p50_ms"] == 9.0
+    lines = _serving_section(s)
+    text = "\n".join(lines)
+    assert "## serving" in text
+    assert "serve/spade/256x256/bs4" in text
+    assert "p99 20.5ms" in text
+
+
+def test_report_no_serving_section_without_counters():
+    from imaginaire_tpu.telemetry.report import (
+        _serving_section,
+        summarize,
+    )
+
+    s = summarize([{"kind": "counter", "t": 1.0, "name": "xla/recompiles",
+                    "value": 0, "step": 1}])
+    assert s["serving"]["present"] is False
+    assert _serving_section(s) == []
