@@ -148,17 +148,25 @@ def _serving_leg(tm):
     """{p50_ms, p99_ms, requests, bucket_hit_rate, pad_waste_frac} for
     one bench leg (ISSUE 19) — the serving engine's latest SLO counters
     when the leg pushed requests through the warm executable pool.
-    None for legs that never served."""
+    None for legs that never served. ISSUE 20 adds the error-budget
+    gauges (burn rate, remaining budget, breach/shed counts) and the
+    leg's trace volume."""
     latest = {}
+    traces = 0
     keep = ("serve/p50_ms", "serve/p99_ms", "serve/requests",
             "serve/bucket_hit_rate", "serve/pad_waste_frac",
-            "serve/queue_depth")
+            "serve/queue_depth", "serve/slo/burn_rate",
+            "serve/slo/budget_remaining_frac", "serve/slo/breaches",
+            "serve/slo/rejected")
     try:
         with tm._lock:
             events = list(tm._events)
         for ev in events:
             if ev.get("kind") == "counter" and ev.get("name") in keep:
                 latest[ev["name"]] = ev.get("value")
+            elif (ev.get("kind") == "trace"
+                  and ev.get("name") == "trace/request"):
+                traces += 1
     except Exception:  # noqa: BLE001 — bench accounting is best-effort
         pass
     if not latest:
@@ -170,6 +178,12 @@ def _serving_leg(tm):
         "bucket_hit_rate": latest.get("serve/bucket_hit_rate"),
         "pad_waste_frac": latest.get("serve/pad_waste_frac"),
         "queue_depth": latest.get("serve/queue_depth"),
+        "slo_burn_rate": latest.get("serve/slo/burn_rate"),
+        "slo_budget_remaining_frac":
+            latest.get("serve/slo/budget_remaining_frac"),
+        "slo_breaches": latest.get("serve/slo/breaches"),
+        "slo_rejected": latest.get("serve/slo/rejected"),
+        "traces": traces,
     }
 
 

@@ -467,6 +467,13 @@ def default_config():
             # this models a genuinely regressed model, which stays bad.
             degrade_eval_at_sweep=None,
             degrade_eval_scale=1.0,
+            # serving latency spike (ISSUE 20): sleep delay_serve_ms
+            # inside the execute span of delay_serve_count consecutive
+            # requests starting at the Nth served request (1-based) —
+            # the red path of the SLO burn-rate gate.
+            delay_serve_at_request=None,
+            delay_serve_ms=50.0,
+            delay_serve_count=1,
         ),
         # -- quality observability plane (evaluation/plane.py, ISSUE
         # 18): continuous FID/KID during training. every_n_iter sets
@@ -543,6 +550,20 @@ def default_config():
             remat=None,
             max_executables=16,
             seed=0,
+            # -- request-scoped observability (ISSUE 20).
+            # trace_sample_rate: fraction of requests whose trace is
+            # emitted to the jsonl (deterministic per request id; SLO-
+            # breaching requests are ALWAYS emitted regardless).
+            trace_sample_rate=1.0,
+            # slo: the serving contract. p99_ms None disables the SLO
+            # layer entirely; availability is the fraction of requests
+            # allowed to meet p99_ms (burn rate = observed bad frac /
+            # allowed bad frac over the last `window` requests).
+            slo=AttrDict(
+                p99_ms=None,
+                availability=0.999,
+                window=256,
+            ),
         ),
         # -- TPU runtime (replaces ref cudnn/local_rank blocks, config.py:143-150)
         runtime=AttrDict(

@@ -85,6 +85,15 @@ def chaos_settings(cfg):
         "degrade_eval_at_sweep": step("degrade_eval_at_sweep"),
         "degrade_eval_scale": float(cfg_get(ccfg, "degrade_eval_scale",
                                             1.0) or 1.0),
+        # serving latency spike (ISSUE 20): sleep inside the execute
+        # span of the Nth served request (1-based ordinal) onward for
+        # ``delay_serve_count`` requests — drives the SLO burn-rate
+        # red path of the serving dryrun leg
+        "delay_serve_at_request": step("delay_serve_at_request"),
+        "delay_serve_ms": float(cfg_get(ccfg, "delay_serve_ms", 50.0)
+                                or 0.0),
+        "delay_serve_count": int(cfg_get(ccfg, "delay_serve_count", 1)
+                                 or 1),
     }
 
 
@@ -249,6 +258,25 @@ class ChaosMonkey:
         self._should("degrade_eval", at, at)  # one-shot meta marker
         return float(fid) * (1.0 + self.settings["degrade_eval_scale"])
 
+    def maybe_delay_serve(self, ordinal):
+        """Serving latency spike (ISSUE 20): sleep ``delay_serve_ms``
+        inside the engine's execute span for requests
+        ``[delay_serve_at_request, delay_serve_at_request +
+        delay_serve_count)`` (1-based served-request ordinal). A run of
+        consecutive slow requests — not a single outlier — is what an
+        SLO burn-rate gate must go red on; the ``chaos/delay_serve``
+        meta is emitted once per delayed request so the jsonl names
+        exactly which requests were poisoned."""
+        at = self.settings["delay_serve_at_request"]
+        if not self.enabled or at is None \
+                or not at <= ordinal < at + self.settings[
+                    "delay_serve_count"]:
+            return
+        if self._should("delay_serve", ordinal, ordinal):
+            import time
+
+            time.sleep(self.settings["delay_serve_ms"] / 1e3)
+
     def maybe_io_error(self, site):
         """Raise a one-shot ``ChaosIOError`` on the configured site's
         Nth access (sites count their own calls — loader/flow-store
@@ -290,6 +318,9 @@ class _NullChaos:
 
     def maybe_degrade_eval(self, fid, sweep_index):
         return fid
+
+    def maybe_delay_serve(self, ordinal):
+        pass
 
     def maybe_io_error(self, site):
         pass

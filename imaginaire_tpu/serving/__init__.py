@@ -1,5 +1,8 @@
 """Production serving (ISSUE 19): AOT-warmed executable pool, bucketed
-micro-batching, and streaming vid2vid sessions. See ``engine.py``."""
+micro-batching, and streaming vid2vid sessions (``engine.py``) — plus
+the request-scoped observability plane (ISSUE 20): per-request traces
+(``tracing.py``), SLO error budgets (``slo.py``), and the closed/open-
+loop load harness (``loadgen.py``)."""
 
 from imaginaire_tpu.serving.engine import (  # noqa: F401
     BucketCfg,
@@ -12,4 +15,20 @@ from imaginaire_tpu.serving.engine import (  # noqa: F401
     StreamSession,
     engine_from_config,
     serving_settings,
+)
+from imaginaire_tpu.serving.loadgen import (  # noqa: F401
+    poisson_arrivals,
+    run_closed_loop,
+    run_load_sweep,
+    run_open_loop,
+    run_stream_burst,
+)
+from imaginaire_tpu.serving.slo import (  # noqa: F401
+    ErrorBudget,
+    slo_settings,
+)
+from imaginaire_tpu.serving.tracing import (  # noqa: F401
+    REQUEST_SPANS,
+    RequestTrace,
+    Tracer,
 )

@@ -150,6 +150,8 @@ def serving_settings(cfg):
             buckets.append(BucketCfg(int(entry[0]), int(entry[1]),
                                      global_bs, global_dtype,
                                      global_remat, global_fused))
+    from imaginaire_tpu.serving.slo import slo_settings
+
     return {
         "families": list(cfg_get(scfg, "families", None) or ["spade"]),
         "buckets": buckets,
@@ -160,6 +162,9 @@ def serving_settings(cfg):
         "remat": global_remat,
         "max_executables": int(cfg_get(scfg, "max_executables", 16)),
         "seed": int(cfg_get(scfg, "seed", 0)),
+        "trace_sample_rate": float(cfg_get(scfg, "trace_sample_rate",
+                                           1.0)),
+        "slo": slo_settings(cfg),
     }
 
 
@@ -183,6 +188,11 @@ class ExecutablePool:
         self._lock = threading.RLock()
         self.builds = 0
         self.evictions = 0
+        # labels that have EVER been evicted and not yet rebuilt: a
+        # subsequent miss on one of these is an evict-then-recompile —
+        # the expensive tail event request traces must attribute
+        # (ISSUE 20), distinct from a plain first-seen cold compile
+        self.evicted_labels = set()
 
     def __len__(self):
         return len(self._programs)
@@ -212,9 +222,11 @@ class ExecutablePool:
         with self._lock:
             self._programs[key] = prog
             self.builds += 1
+            self.evicted_labels.discard(key.label)
             while len(self._programs) > self.max_entries:
                 old_key, _ = self._programs.popitem(last=False)
                 self.evictions += 1
+                self.evicted_labels.add(old_key.label)
                 logger.info("serving pool: evicted %s (LRU, max %d)",
                             old_key.label, self.max_entries)
                 from imaginaire_tpu import telemetry
@@ -222,6 +234,13 @@ class ExecutablePool:
                 telemetry.get().meta("serve/evict", label=old_key.label,
                                      pool_size=len(self._programs))
         return prog
+
+    def is_evict_recompile(self, key):
+        """True when a ``get(key)`` now would pay a rebuild of a label
+        this pool previously evicted (vs a first-seen cold compile)."""
+        with self._lock:
+            return (key not in self._programs
+                    and key.label in self.evicted_labels)
 
     def warm(self, key, *example_args):
         """AOT-compile ``key`` for these example args without executing
@@ -336,8 +355,11 @@ class StreamSession:
         self.prev_labels = None
         self.prev_images = None
         self.t = 0
+        engine.tracer.lifecycle("open", stream_id, history=self.history)
 
     def reset(self):
+        self.engine.tracer.lifecycle("reset", self.stream_id,
+                                     frame=self.t)
         self.prev_labels = None
         self.prev_images = None
         self.t = 0
@@ -345,15 +367,28 @@ class StreamSession:
     def step(self, data, seed=None):
         """Generate the next frame from a single-frame data dict;
         returns the fake frame as a host numpy array while the ring
-        buffers keep the device arrays."""
+        buffers keep the device arrays.
+
+        Each frame gets its own trace (trace_id
+        ``<family>/<stream_id>/frame-N``): admit -> h2d_transfer (host
+        frame upload) -> bucket/pad (conditioning assembly from the
+        device-resident rings) -> execute -> d2h/slice (host copy +
+        ring roll) -> respond. Stream traces carry ``stream_id`` so
+        interleaved streams stay separable in the jsonl.
+        """
         from imaginaire_tpu.model_utils.fs_vid2vid import concat_frames
         from imaginaire_tpu.utils.misc import numeric_only, to_device
 
         engine = self.engine
         trainer = engine.trainer
         t_submit = time.perf_counter()
+        trace = engine.tracer.admit(next(_REQUEST_IDS),
+                                    stream_id=self.stream_id,
+                                    frame=self.t, t0=t_submit)
+        trace.mark("h2d_transfer")
         data = to_device(trainer._start_of_iteration(
             numeric_only(dict(data)), -1))
+        trace.mark("bucket/pad")
         data_t = trainer._get_data_t(data, 0, self.prev_labels,
                                      self.prev_images)
         call_data = {k: v for k, v in data_t.items()
@@ -363,7 +398,12 @@ class StreamSession:
         rng = _prng(seed * 100003 + self.t)
         key = engine._exec_key(h, w, 1, tag="stream")
         hit = key in engine.pool
+        evict_recompile = (not hit) and engine.pool.is_evict_recompile(
+            key)
+        trace.mark("execute")
+        engine._maybe_chaos_delay(1)
         fake = engine._run(key, call_data, rng)
+        trace.mark("d2h/slice")
         # rings advance with the DEVICE arrays: frame t+1 of this
         # stream conditions on buffers already resident on chip
         self.prev_labels = concat_frames(self.prev_labels,
@@ -371,8 +411,14 @@ class StreamSession:
         self.prev_images = concat_frames(self.prev_images, fake,
                                          self.history)
         self.t += 1
-        engine._account(key, [t_submit], hit=hit, lanes=1, padded=0)
-        return np.asarray(fake)
+        out = np.asarray(fake)
+        trace.mark("respond")
+        trace.annotate(executable=key.label, batch_size=1, lanes=1,
+                       padded=0, warm_hit=bool(hit),
+                       evict_recompile=bool(evict_recompile))
+        engine._account(key, [t_submit], hit=hit, lanes=1, padded=0,
+                        traces=[trace])
+        return out
 
 
 # -------------------------------------------------------------- engine
@@ -405,9 +451,21 @@ def _hbm_headroom_frac():
 
 
 def _percentile(samples, q):
+    """Linear-interpolated percentile, hardened for tiny samples
+    (ISSUE 20 satellite): ``None`` on empty (the old rounding form
+    raised IndexError), the sole element for n=1, and interpolation for
+    n=2 — p50 of ``[10, 20]`` is 15, not 20 (nearest-rank rounding made
+    every percentile of a 2-sample ring collapse to the max, so the
+    first post-reset flush reported a wildly pessimistic p50)."""
+    if not samples:
+        return None
     ordered = sorted(samples)
-    idx = min(int(q * (len(ordered) - 1) + 0.5), len(ordered) - 1)
-    return ordered[idx]
+    if len(ordered) == 1:
+        return ordered[0]
+    pos = q * (len(ordered) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(ordered) - 1)
+    return ordered[lo] + (ordered[hi] - ordered[lo]) * (pos - lo)
 
 
 class ServingEngine:
@@ -442,6 +500,13 @@ class ServingEngine:
         self._inference_args_by_opts = {(): dict(
             cfg_get(self.cfg, "inference_args", None) or {})}
         self._variables = None
+        # latency rings are bounded SLIDING WINDOWS (maxlen, below) over
+        # the most recent requests — telemetry flush does NOT clear them
+        # (flush drains the event buffer, not engine state), so a post-
+        # flush percentile still reflects the live window. The one
+        # boundary that must not leak samples is a measurement boundary
+        # (bench legs, loadgen load points): call ``reset_stats()``
+        # there, or point N's p99 inherits point N-1's tail.
         self._latencies = deque(maxlen=2048)
         self._bucket_exec_ms = {}  # label -> deque of batch exec ms
         self._hits = 0
@@ -451,6 +516,16 @@ class ServingEngine:
         self._batches = 0
         self._sessions = {}
         self._verified_restore = False
+        # -- request-scoped observability (ISSUE 20) --
+        from imaginaire_tpu.serving.slo import ErrorBudget
+        from imaginaire_tpu.serving.tracing import Tracer
+
+        self.tracer = Tracer(self.family,
+                             self.settings["trace_sample_rate"])
+        self.budget = ErrorBudget.from_settings(self.settings["slo"])
+        self._traces = {}  # request_id -> in-flight RequestTrace
+        self._served = 0   # request ordinal (chaos delay_serve site)
+        self._slo_config_emitted = False
 
     # ------------------------------------------------------- lifecycle
 
@@ -683,12 +758,33 @@ class ServingEngine:
 
     def submit(self, request):
         """Enqueue one request; returns its ticket id. Call ``pump``
-        (or ``flush``) to execute."""
-        ticket = self.queue.submit(request)
-        from imaginaire_tpu import telemetry
+        (or ``flush``) to execute.
 
-        telemetry.get().counter("serve/queue_depth", self.queue.depth,
-                                step=self._batches)
+        This is where the request's trace is born: the admit span is
+        anchored at ``request.t_submit`` (scheduled arrival under open-
+        loop load), so queue-induced lateness lands in the trace, not
+        outside it. A shed request (queue overflow) still gets a trace
+        — rejected, budget-charged, always emitted.
+
+        Note: ``serve/queue_depth`` is NOT emitted here. It used to be
+        emitted both at enqueue and in ``_emit_slo``, interleaving two
+        cadences into one series; ``_emit_slo`` (post-batch) is the one
+        authoritative emission.
+        """
+        trace = self.tracer.admit(request.id, t0=request.t_submit)
+        trace.annotate(queue_depth_at_admit=self.queue.depth)
+        try:
+            ticket = self.queue.submit(request)
+        except ServingError:
+            trace.annotate(rejected=True)
+            trace.mark("respond").finish()
+            self.budget.observe_rejected(trace=trace)
+            self.tracer.emit(trace)
+            raise
+        # admit closes, queue_wait opens; it stays open until THIS
+        # request's chunk starts executing (not its group's first chunk)
+        trace.mark("queue_wait")
+        self._traces[request.id] = trace
         return ticket
 
     def pump(self, now=None):
@@ -738,8 +834,18 @@ class ServingEngine:
             raise ServingError("initialize() before serving")
         key = self._exec_key(hw[0], hw[1], bs)
         hit = hit and key in self.pool
+        evict_recompile = (not hit) and self.pool.is_evict_recompile(key)
         pad = bs - len(chunk)
-        data = {}
+        # each request's queue_wait span ends when ITS chunk starts —
+        # not when the group's first chunk did — so a request stuck
+        # behind an earlier chunk keeps that wait inside queue_wait and
+        # spans stay contiguous (they must sum to e2e latency)
+        traces = [self._traces.pop(r.id, None) for r in chunk]
+        t_stage = time.perf_counter()
+        for tr in traces:
+            if tr is not None:
+                tr.mark("bucket/pad", t=t_stage)
+        host = {}
         for name in chunk[0].data:
             lanes = [np.asarray(r.data[name]) for r in chunk]
             stacked = np.concatenate(lanes, axis=0)
@@ -750,19 +856,52 @@ class ServingEngine:
                 stacked = np.concatenate(
                     [stacked, np.zeros((pad,) + stacked.shape[1:],
                                        stacked.dtype)], axis=0)
-            # device_put so warm (jnp) and live (np) calls share one
-            # fingerprint — a host/device mismatch would re-specialize
-            data[name] = jax.device_put(stacked)
+            host[name] = stacked
         # one noise key per lane, derived from the request's own seed —
         # pad lanes get a throwaway key (their output is sliced off)
-        rng = jax.device_put(np.stack(
-            [np.asarray(_prng(r.seed)) for r in chunk]
-            + [np.zeros(2, np.uint32)] * pad))
+        rng_host = np.stack([np.asarray(_prng(r.seed)) for r in chunk]
+                            + [np.zeros(2, np.uint32)] * pad)
+        t_stage = time.perf_counter()
+        for tr in traces:
+            if tr is not None:
+                tr.mark("h2d_transfer", t=t_stage)
+        # device_put so warm (jnp) and live (np) calls share one
+        # fingerprint — a host/device mismatch would re-specialize
+        data = {name: jax.device_put(arr) for name, arr in host.items()}
+        rng = jax.device_put(rng_host)
+        t_stage = time.perf_counter()
+        for tr in traces:
+            if tr is not None:
+                tr.mark("execute", t=t_stage)
+        self._maybe_chaos_delay(len(chunk))
         images = self._run(key, data, rng)
+        t_stage = time.perf_counter()
+        for tr in traces:
+            if tr is not None:
+                tr.mark("d2h/slice", t=t_stage)
         images = np.asarray(images)[:len(chunk)]
+        t_stage = time.perf_counter()
+        for tr in traces:
+            if tr is not None:
+                tr.mark("respond", t=t_stage)
+                tr.annotate(executable=key.label, batch_size=bs,
+                            lanes=len(chunk), padded=pad,
+                            warm_hit=bool(hit),
+                            evict_recompile=bool(evict_recompile))
         self._account(key, [r.t_submit for r in chunk], hit=hit,
-                      lanes=bs, padded=pad)
+                      lanes=bs, padded=pad, traces=traces)
         return {req.id: images[j] for j, req in enumerate(chunk)}
+
+    def _maybe_chaos_delay(self, nreqs):
+        """The ``delay_serve_at_request`` chaos site (ISSUE 20 dryrun
+        red path): advance the served-request ordinal and let the chaos
+        plane inject a latency spike inside the execute span."""
+        from imaginaire_tpu.resilience import chaos
+
+        monkey = chaos.get()
+        for _ in range(max(int(nreqs), 1)):
+            self._served += 1
+            monkey.maybe_delay_serve(self._served)
 
     def _run(self, key, data, rng):
         """Dispatch one pooled executable and fence the result (serving
@@ -796,20 +935,35 @@ class ServingEngine:
                                 for k, v in dict(inference_args).items()))
             self._inference_args_by_opts.setdefault(
                 opts, dict(inference_args))
-        data = numeric_only(dict(data))
+        probe = ServeRequest(data=numeric_only(dict(data)))
+        data = probe.data
         bs = None
         for v in data.values():
             if len(getattr(v, "shape", ())) == 4:
                 bs = int(v.shape[0])
                 break
-        h, w = ServeRequest(data=data).hw
+        # one-shot seam: no queue, so the trace is the queue-path
+        # subset admit -> bucket/pad -> h2d_transfer -> execute ->
+        # respond (the caller keeps the device array; no d2h here)
+        trace = self.tracer.admit(probe.id, t0=t_submit)
+        trace.mark("bucket/pad")
+        h, w = probe.hw
         key = self._exec_key(h, w, bs or 1, tag="batch", opts=opts)
         hit = key in self.pool
+        evict_recompile = (not hit) and self.pool.is_evict_recompile(key)
         import jax
 
+        trace.mark("h2d_transfer")
         data = jax.device_put(data)
+        trace.mark("execute")
+        self._maybe_chaos_delay(1)
         images = self._run(key, data, rng)
-        self._account(key, [t_submit], hit=hit, lanes=bs or 1, padded=0)
+        trace.mark("respond")
+        trace.annotate(executable=key.label, batch_size=bs or 1,
+                       lanes=bs or 1, padded=0, warm_hit=bool(hit),
+                       evict_recompile=bool(evict_recompile))
+        self._account(key, [t_submit], hit=hit, lanes=bs or 1, padded=0,
+                      traces=[trace])
         return images
 
     def attach(self):
@@ -820,10 +974,11 @@ class ServingEngine:
 
     # ------------------------------------------------------ telemetry
 
-    def _account(self, key, submit_times, hit, lanes, padded):
+    def _account(self, key, submit_times, hit, lanes, padded,
+                 traces=None):
         now = time.perf_counter()
-        for t in submit_times:
-            self._latencies.append((now - t) * 1e3)
+        latencies = [(now - t) * 1e3 for t in submit_times]
+        self._latencies.extend(latencies)
         if hit:
             self._hits += 1
         else:
@@ -831,6 +986,17 @@ class ServingEngine:
         self._lane_total += int(lanes)
         self._lane_padded += int(padded)
         self._batches += 1
+        # budget verdict BEFORE emission: a breach flips the trace to
+        # always-emit (and stamps dominant_span into the breach meta)
+        # regardless of the sampling decision taken at admit
+        traces = traces or []
+        for j, latency_ms in enumerate(latencies):
+            trace = traces[j] if j < len(traces) else None
+            if trace is not None:
+                trace.finish(t=now)
+            self.budget.observe(latency_ms, trace=trace)
+            if trace is not None:
+                self.tracer.emit(trace)
         self._emit_slo(key)
 
     def _emit_slo(self, key=None):
@@ -870,6 +1036,15 @@ class ServingEngine:
                 tm.counter(f"{prefix}/p99_ms",
                            _percentile(list(ring), 0.99), step=step)
                 tm.counter(f"{prefix}/count", len(ring), step=step)
+        if self.budget.enabled:
+            if not self._slo_config_emitted:
+                self._slo_config_emitted = True
+                tm.meta("serve/slo/config", family=self.family,
+                        p99_ms=self.budget.p99_ms,
+                        availability=self.budget.availability,
+                        window=self.budget.window.maxlen)
+            for name, value in self.budget.counters().items():
+                tm.counter(name, value, step=step)
 
     # -------------------------------------------------------- streams
 
@@ -882,9 +1057,28 @@ class ServingEngine:
         return session
 
     def close_stream(self, stream_id):
-        self._sessions.pop(stream_id, None)
+        session = self._sessions.pop(stream_id, None)
+        if session is not None:
+            self.tracer.lifecycle("close", stream_id, frame=session.t)
 
     # ---------------------------------------------------------- stats
+
+    def reset_stats(self):
+        """Zero the sliding-window accounting at a measurement boundary
+        (bench legs, loadgen load points): latency + per-executable
+        exec-ms rings, hit/pad counters, and the SLO error-budget
+        window. The ``_batches`` step axis is deliberately NOT reset —
+        counter series must stay monotone in ``step`` across
+        boundaries. Pool contents and in-flight traces are untouched
+        (warm executables are the fixture, not the measurement)."""
+        self._latencies.clear()
+        for ring in self._bucket_exec_ms.values():
+            ring.clear()
+        self._hits = 0
+        self._misses = 0
+        self._lane_total = 0
+        self._lane_padded = 0
+        self.budget.reset()
 
     def stats(self):
         lat = list(self._latencies)
@@ -903,6 +1097,14 @@ class ServingEngine:
             "pool_evictions": self.pool.evictions,
             "verified_restore": self._verified_restore,
             "hbm_headroom_frac": _hbm_headroom_frac(),
+            "traces_started": self.tracer.started,
+            "traces_emitted": self.tracer.emitted,
+            "slo_burn_rate": (self.budget.burn_rate()
+                              if self.budget.enabled else None),
+            "slo_budget_remaining_frac": (
+                self.budget.budget_remaining_frac()
+                if self.budget.enabled else None),
+            "slo_breaches": self.budget.breaches,
         }
 
 

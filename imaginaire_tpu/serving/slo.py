@@ -1,0 +1,157 @@
+"""Serving SLO error budgets (ISSUE 20).
+
+``cfg.serving.slo`` declares the contract the serving path is held to:
+
+    slo:
+      p99_ms: 250.0        # latency objective per request (None = off)
+      availability: 0.999  # fraction of requests allowed to meet it
+      window: 256          # rolling window (requests) for burn rate
+
+``ErrorBudget`` keeps a rolling window of good/bad verdicts. A request
+is *bad* when its end-to-end latency exceeds ``p99_ms`` or it was shed
+at admission (queue overflow). The availability target implies an
+allowed bad fraction (``1 - availability``); the burn rate is how fast
+we spend it:
+
+    burn_rate = bad_frac_in_window / allowed_bad_frac
+
+burn_rate 1.0 means we are consuming budget exactly as fast as the SLO
+permits; >1.0 means the budget will be exhausted before the window
+turns over. ``budget_remaining_frac = max(0, 1 - burn_rate)`` is the
+headline gauge check_run_health gates on.
+
+Every breach immediately emits a ``serve/slo/breach`` meta naming the
+dominant span of the breaching trace — the report and the gate can say
+*which stage* ate the budget, not just that it was eaten.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from imaginaire_tpu.config import cfg_get
+
+
+def slo_settings(cfg):
+    """Parse ``cfg.serving.slo`` (missing / p99_ms=None → disabled)."""
+    scfg = cfg_get(cfg or {}, "serving", None) or {}
+    slo = cfg_get(scfg, "slo", None) or {}
+    p99_ms = cfg_get(slo, "p99_ms", None)
+    return {
+        "p99_ms": None if p99_ms is None else float(p99_ms),
+        "availability": float(cfg_get(slo, "availability", 0.999)),
+        "window": max(int(cfg_get(slo, "window", 256)), 1),
+    }
+
+
+class ErrorBudget:
+    """Rolling-window error budget for one serving engine.
+
+    ``observe(latency_ms, trace=)`` files a verdict and returns whether
+    the request breached; ``observe_rejected`` files a shed request
+    (always bad). ``counters()`` yields the serve/slo/* gauge values
+    the engine flushes alongside its latency percentiles.
+    """
+
+    def __init__(self, p99_ms=None, availability=0.999, window=256):
+        self.p99_ms = None if p99_ms is None else float(p99_ms)
+        self.availability = float(availability)
+        # allowed bad fraction; floor avoids div-by-zero for
+        # availability=1.0 (every breach is then an immediate burn).
+        self.allowed_bad_frac = max(1.0 - self.availability, 1e-9)
+        self.window = deque(maxlen=max(int(window), 1))
+        self.breaches = 0
+        self.rejected = 0
+        self.observed = 0
+
+    @classmethod
+    def from_settings(cls, settings):
+        return cls(p99_ms=settings["p99_ms"],
+                   availability=settings["availability"],
+                   window=settings["window"])
+
+    @property
+    def enabled(self):
+        return self.p99_ms is not None
+
+    # --------------------------------------------------------- verdicts
+
+    def observe(self, latency_ms, trace=None):
+        """File one served request; returns True when it breached the
+        latency objective. Marks the trace (breach traces are always
+        emitted regardless of sampling) and emits the breach meta."""
+        self.observed += 1
+        breached = self.enabled and latency_ms > self.p99_ms
+        self.window.append(1 if breached else 0)
+        if breached:
+            self.breaches += 1
+            if trace is not None:
+                trace.slo_breach = True
+            self._emit_breach(latency_ms, trace)
+        return breached
+
+    def observe_rejected(self, trace=None):
+        """File a request shed at admission (queue overflow): counts
+        against the budget whenever the SLO is enabled — a 503 is an
+        availability failure no matter how fast it was."""
+        self.observed += 1
+        self.rejected += 1
+        self.window.append(1 if self.enabled else 0)
+        if self.enabled:
+            self.breaches += 1
+            if trace is not None:
+                trace.slo_breach = True
+            self._emit_breach(None, trace, rejected=True)
+            return True
+        return False
+
+    def _emit_breach(self, latency_ms, trace, rejected=False):
+        from imaginaire_tpu import telemetry
+
+        tm = telemetry.get()
+        if not tm.enabled:
+            return
+        fields = {"target_ms": self.p99_ms, "rejected": bool(rejected)}
+        if latency_ms is not None:
+            fields["e2e_ms"] = round(float(latency_ms), 4)
+        if trace is not None:
+            fields["trace_id"] = trace.trace_id
+            name, dur = trace.dominant_span()
+            if name is not None:
+                fields["dominant_span"] = name
+                fields["dominant_span_ms"] = dur
+            executable = trace.fields.get("executable")
+            if executable:
+                fields["executable"] = executable
+        tm.meta("serve/slo/breach", **fields)
+
+    # ----------------------------------------------------------- gauges
+
+    def bad_frac(self):
+        if not self.window:
+            return 0.0
+        return sum(self.window) / len(self.window)
+
+    def burn_rate(self):
+        return self.bad_frac() / self.allowed_bad_frac
+
+    def budget_remaining_frac(self):
+        return max(0.0, 1.0 - self.burn_rate())
+
+    def counters(self):
+        """serve/slo/* gauge values for the engine's flush block."""
+        return {
+            "serve/slo/burn_rate": round(self.burn_rate(), 6),
+            "serve/slo/budget_remaining_frac":
+                round(self.budget_remaining_frac(), 6),
+            "serve/slo/breaches": self.breaches,
+            "serve/slo/rejected": self.rejected,
+        }
+
+    def reset(self):
+        """Clear the rolling window + counters (load-point boundary in
+        the loadgen sweep; see ServingEngine.reset_stats)."""
+        self.window.clear()
+        self.breaches = 0
+        self.rejected = 0
+        self.observed = 0

@@ -1,0 +1,216 @@
+"""Request-scoped serving traces (ISSUE 20).
+
+The engine's aggregate SLO counters (serve/p50_ms, queue_depth rings)
+answer "is serving healthy" but not "why was THIS request slow". Every
+request therefore gets a trace id at admission and accumulates typed
+spans across the serving pipeline:
+
+    admit -> queue_wait -> bucket/pad -> h2d_transfer -> execute
+          -> d2h/slice -> respond
+
+Spans are contiguous by construction — each starts where the previous
+ended — so a complete trace's span durations sum to its end-to-end
+latency (the dryrun leg asserts within 10%). A trace also carries the
+attribution the aggregate counters cannot: which pooled executable ran
+it, how many pad lanes rode along, whether the executable was a warm
+hit, and — the expensive case — whether a slow request paid an
+ExecutablePool evict-then-recompile (``evict_recompile``).
+
+Emission goes through the existing telemetry jsonl as ``kind="trace"``
+records named ``trace/request`` (per-request) and ``trace/stream``
+(StreamSession open/frame-N/reset/close lifecycle). Sampling is
+deterministic per request id (``cfg.serving.trace_sample_rate``);
+requests that breach the SLO (serving/slo.py) are ALWAYS emitted — the
+traces you need most are the ones sampling would have dropped.
+"""
+
+from __future__ import annotations
+
+import time
+
+# The canonical span sequence of a queued request. ``forward`` (the
+# one-shot inference.py seam) and stream frames use the subset that
+# applies to them; the queue path emits every span exactly once.
+REQUEST_SPANS = ("admit", "queue_wait", "bucket/pad", "h2d_transfer",
+                 "execute", "d2h/slice", "respond")
+
+# Knuth multiplicative hash: the sampling decision is a pure function
+# of the request id, so a replayed request trace samples identically
+# and tests need no RNG patching.
+_HASH_MULT = 2654435761
+_HASH_MOD = 1 << 32
+
+
+def sampled(request_id, rate):
+    """Deterministic sampling verdict for a request id at ``rate``
+    (0.0 never, 1.0 always)."""
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return ((int(request_id) * _HASH_MULT) % _HASH_MOD) / _HASH_MOD < rate
+
+
+class RequestTrace:
+    """One request's span accumulator.
+
+    ``mark(name)`` closes the open span at ``now`` and opens the next —
+    spans are contiguous and monotone by construction. ``annotate``
+    attaches attribution fields (executable label, pad lanes, eviction
+    verdicts). ``finish`` closes the final span and freezes ``e2e_ms``.
+    """
+
+    __slots__ = ("trace_id", "request_id", "kind", "stream_id", "frame",
+                 "sampled", "t0", "spans", "fields", "_cursor", "_open",
+                 "e2e_ms", "slo_breach")
+
+    def __init__(self, trace_id, request_id, kind="request",
+                 stream_id=None, frame=None, is_sampled=True, t0=None):
+        self.trace_id = trace_id
+        self.request_id = request_id
+        self.kind = kind
+        self.stream_id = stream_id
+        self.frame = frame
+        self.sampled = bool(is_sampled)
+        self.t0 = time.perf_counter() if t0 is None else float(t0)
+        self.spans = []
+        self.fields = {}
+        self._cursor = self.t0
+        self._open = None
+        self.e2e_ms = None
+        self.slo_breach = False
+
+    # ------------------------------------------------------------ spans
+
+    def begin(self, name, t=None):
+        """Open span ``name``; closes any currently open span first."""
+        t = time.perf_counter() if t is None else float(t)
+        if self._open is not None:
+            self._close(t)
+        self._open = name
+        self._cursor = max(t, self._cursor)
+        return self
+
+    def _close(self, t):
+        dur_ms = max(t - self._cursor, 0.0) * 1e3
+        self.spans.append({"name": self._open,
+                           "dur_ms": round(dur_ms, 4)})
+        self._open = None
+        self._cursor = t
+
+    def mark(self, name, t=None):
+        """Close the open span at ``t`` and immediately open ``name`` —
+        the contiguous-span fast path the engine uses."""
+        return self.begin(name, t=t)
+
+    def annotate(self, **fields):
+        self.fields.update(fields)
+        return self
+
+    def finish(self, t=None):
+        """Close the final span and freeze the end-to-end latency."""
+        t = time.perf_counter() if t is None else float(t)
+        if self._open is not None:
+            self._close(t)
+        self.e2e_ms = round((t - self.t0) * 1e3, 4)
+        return self
+
+    # ----------------------------------------------------------- verdict
+
+    def dominant_span(self):
+        """(name, dur_ms) of the longest span — what an SLO breach meta
+        names as the culprit."""
+        if not self.spans:
+            return None, None
+        worst = max(self.spans, key=lambda s: s["dur_ms"])
+        return worst["name"], worst["dur_ms"]
+
+    def span_names(self):
+        return [s["name"] for s in self.spans]
+
+    def record(self):
+        """The jsonl payload (everything but kind/name/t, which the
+        telemetry plane stamps)."""
+        rec = {
+            "trace_id": self.trace_id,
+            "request_id": self.request_id,
+            "trace_kind": self.kind,
+            "sampled": self.sampled,
+            "slo_breach": self.slo_breach,
+            "e2e_ms": self.e2e_ms,
+            "spans": list(self.spans),
+        }
+        if self.stream_id is not None:
+            rec["stream_id"] = self.stream_id
+        if self.frame is not None:
+            rec["frame"] = self.frame
+        rec.update(self.fields)
+        return rec
+
+
+class Tracer:
+    """The engine's trace factory + emitter.
+
+    One per ServingEngine. ``admit`` mints the trace id (at admission —
+    the request owns its id for its whole lifetime) and takes the
+    deterministic sampling decision; ``emit`` writes the finished trace
+    to the telemetry plane when it was sampled OR breached the SLO
+    (breach traces are always kept). ``lifecycle`` emits the
+    ``trace/stream`` open/reset/close records.
+    """
+
+    def __init__(self, family, sample_rate=1.0):
+        self.family = str(family)
+        self.sample_rate = float(sample_rate)
+        self.started = 0
+        self.emitted = 0
+        self.dropped = 0
+
+    def admit(self, request_id, stream_id=None, frame=None, t0=None):
+        """Mint the trace for a freshly admitted request. ``t0``
+        (defaults to now) anchors the admit span at the request's
+        ``t_submit`` so span durations sum to the same end-to-end
+        latency ``_account`` measures — including scheduling delay
+        under open-loop load (no coordinated omission)."""
+        self.started += 1
+        if stream_id is not None:
+            trace_id = f"{self.family}/{stream_id}/frame-{frame}"
+        else:
+            trace_id = f"{self.family}/r{int(request_id)}"
+        trace = RequestTrace(
+            trace_id, int(request_id),
+            kind="stream" if stream_id is not None else "request",
+            stream_id=stream_id, frame=frame,
+            is_sampled=sampled(request_id, self.sample_rate), t0=t0)
+        trace.begin("admit", t=trace.t0)
+        return trace
+
+    def emit(self, trace):
+        """Write the finished trace (sampled or breaching); returns
+        True when it actually landed in the plane."""
+        if not (trace.sampled or trace.slo_breach):
+            self.dropped += 1
+            return False
+        from imaginaire_tpu import telemetry
+
+        tm = telemetry.get()
+        if not tm.enabled:
+            return False
+        tm.trace("trace/request", family=self.family, **trace.record())
+        self.emitted += 1
+        return True
+
+    def lifecycle(self, event, stream_id, frame=None, **fields):
+        """StreamSession lifecycle record: open / reset / close (frame
+        traces go through admit/emit like any request)."""
+        from imaginaire_tpu import telemetry
+
+        tm = telemetry.get()
+        if not tm.enabled:
+            return
+        rec = {"family": self.family, "event": str(event),
+               "stream_id": str(stream_id)}
+        if frame is not None:
+            rec["frame"] = int(frame)
+        rec.update(fields)
+        tm.trace("trace/stream", **rec)

@@ -266,6 +266,20 @@ class Telemetry:
         with self._lock:
             self._events.append(event)
 
+    def trace(self, name, **fields):
+        """Record a request-scoped trace (ISSUE 20): a ``kind="trace"``
+        event carrying a span list + attribution fields for ONE serving
+        request (``trace/request``) or stream lifecycle transition
+        (``trace/stream``). Distinct from ``span`` (aggregate phase
+        timing) and ``meta`` (one-off annotations) so the report can
+        collect traces without sniffing field shapes."""
+        if not self.enabled:
+            return
+        event = dict({"kind": "trace", "name": name, "t": time.time()},
+                     **fields)
+        with self._lock:
+            self._events.append(event)
+
     def set_step_flops(self, flops, source="cost_analysis"):
         """Register FLOPs per training iteration (D+G, multipliers
         included) — computed ONCE, at jit time, from

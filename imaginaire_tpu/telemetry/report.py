@@ -67,6 +67,10 @@ def summarize(events):
     eval_series = {}
     eval_sweep_events = []
     regression_events = []
+    trace_records = []
+    stream_trace_events = []
+    slo_series = {}
+    slo_breach_events = []
     meta = {}
     hangs = []
     t_min = t_max = None
@@ -102,6 +106,12 @@ def summarize(events):
                 # full series for quality counters (ISSUE 18): the
                 # report renders the per-sweep trend, not the latest
                 eval_series.setdefault(ev["name"], []).append(
+                    [ev.get("step"), ev.get("value")])
+            elif str(ev["name"]).startswith("serve/slo/"):
+                # full series for the error budget (ISSUE 20): the
+                # burn-rate gate thresholds the series MAX — a budget
+                # that burned and recovered still burned
+                slo_series.setdefault(ev["name"], []).append(
                     [ev.get("step"), ev.get("value")])
         elif kind == "meta":
             name = ev.get("name")
@@ -148,9 +158,18 @@ def summarize(events):
                 eval_sweep_events.append(ev)
             elif name == "eval/regression":
                 regression_events.append(ev)
+            elif name == "serve/slo/breach":
+                slo_breach_events.append(ev)
             elif str(name).startswith("chaos/"):
                 chaos_events.append(ev)
             meta[ev.get("name", "?")] = ev
+        elif kind == "trace":
+            # request-scoped serving traces (ISSUE 20): per-request
+            # span records vs stream lifecycle transitions
+            if ev.get("name") == "trace/stream":
+                stream_trace_events.append(ev)
+            else:
+                trace_records.append(ev)
         elif kind == "hang":
             hangs.append(ev)
     wall_s = (t_max - t_min) if t_min is not None else 0.0
@@ -377,9 +396,79 @@ def summarize(events):
         if stat in ("p50_ms", "p99_ms", "count") and \
                 label.count("/") >= 2:
             serve_buckets.setdefault(label, {})[stat] = value
+    # request-scoped traces (ISSUE 20): per-span aggregate table over
+    # every trace/request record, plus breach/eviction attribution —
+    # the "why was THIS request slow" plane rendered aggregate-side
+    span_durs = {}
+    trace_breaches = 0
+    trace_evict_recompiles = 0
+    trace_sampled = 0
+    for rec in trace_records:
+        if rec.get("slo_breach"):
+            trace_breaches += 1
+        if rec.get("evict_recompile"):
+            trace_evict_recompiles += 1
+        if rec.get("sampled"):
+            trace_sampled += 1
+        for sp in rec.get("spans") or []:
+            span_durs.setdefault(str(sp.get("name")), []).append(
+                float(sp.get("dur_ms") or 0.0))
+    span_table = {}
+    for name, durs in span_durs.items():
+        span_table[name] = {
+            "count": len(durs),
+            "total_ms": sum(durs),
+            "mean_ms": sum(durs) / len(durs),
+            "p50_ms": _percentile(durs, 0.50),
+            "p99_ms": _percentile(durs, 0.99),
+        }
+    traces = {
+        "present": bool(trace_records or stream_trace_events),
+        "count": len(trace_records),
+        "sampled": trace_sampled,
+        "breaches": trace_breaches,
+        "evict_recompiles": trace_evict_recompiles,
+        "spans": span_table,
+        "records": trace_records,
+        "stream_events": stream_trace_events,
+        "stream_ids": sorted(
+            {str(rec["stream_id"]) for rec in trace_records
+             if rec.get("stream_id") is not None}
+            | {str(ev["stream_id"]) for ev in stream_trace_events
+               if ev.get("stream_id") is not None}),
+    }
+    # SLO error budget (ISSUE 20): check_run_health
+    # --max-slo-burn-rate / --min-slo-budget-frac threshold the series
+    # extremes, the breach metas carry the dominant-span attribution
+    burn_series = slo_series.get("serve/slo/burn_rate", [])
+    budget_series = slo_series.get("serve/slo/budget_remaining_frac",
+                                   [])
+    burn_vals = [float(v) for _, v in burn_series
+                 if isinstance(v, (int, float))]
+    budget_vals = [float(v) for _, v in budget_series
+                   if isinstance(v, (int, float))]
+    slo = {
+        "present": bool(slo_series or slo_breach_events
+                        or "serve/slo/config" in meta),
+        "config": meta.get("serve/slo/config"),
+        "burn_rate_latest": burn_vals[-1] if burn_vals else None,
+        "burn_rate_max": max(burn_vals) if burn_vals else None,
+        "budget_remaining_frac": (budget_vals[-1] if budget_vals
+                                  else None),
+        "budget_remaining_min": (min(budget_vals) if budget_vals
+                                 else None),
+        "breaches": int(
+            counters.get("serve/slo/breaches", (0, None))[0] or 0)
+        or len(slo_breach_events),
+        "rejected": int(
+            counters.get("serve/slo/rejected", (0, None))[0] or 0),
+        "breach_events": slo_breach_events,
+        "series": slo_series,
+    }
     serving = {
         "present": any(str(n).startswith("serve/") for n in counters)
-        or any(str(n).startswith("serve/") for n in meta),
+        or any(str(n).startswith("serve/") for n in meta)
+        or traces["present"],
         "p50_ms": counters.get("serve/p50_ms", (None, None))[0],
         "p99_ms": counters.get("serve/p99_ms", (None, None))[0],
         "requests": int(counters.get("serve/requests", (0, None))[0]
@@ -394,6 +483,8 @@ def summarize(events):
                                           (None, None))[0],
         "buckets": serve_buckets,
         "weights_meta": meta.get("serve/weights"),
+        "traces": traces,
+        "slo": slo,
     }
     return {"phases": table, "counters": counters, "meta": meta,
             "hangs": hangs, "wall_s": wall_s, "health": health,
@@ -763,7 +854,132 @@ def _serving_section(s):
                 f"| {f'{p50:.1f}' if p50 is not None else '-'} "
                 f"| {f'{p99:.1f}' if p99 is not None else '-'} "
                 f"| {int(b.get('count') or 0)} |")
+    lines.extend(_trace_lines(sv))
+    lines.extend(_slo_lines(sv))
     return lines
+
+
+def _trace_lines(sv):
+    """Span-breakdown lines from the request-scoped traces (ISSUE 20):
+    where the aggregate request latency actually goes, stage by stage,
+    plus eviction-recompile attribution and stream lifecycle counts."""
+    tr = sv.get("traces") or {}
+    if not tr.get("present"):
+        return []
+    lines = [
+        f"- traces: {tr.get('count', 0)} request(s) recorded "
+        f"({tr.get('breaches', 0)} SLO breach(es), "
+        f"{tr.get('evict_recompiles', 0)} evict-recompile(s))"]
+    spans = tr.get("spans") or {}
+    if spans:
+        lines.append("| span | count | total ms | mean ms | p50 ms "
+                     "| p99 ms |")
+        lines.append("|---|---|---|---|---|---|")
+        # pipeline order, then anything unexpected alphabetically
+        order = ("admit", "queue_wait", "bucket/pad", "h2d_transfer",
+                 "execute", "d2h/slice", "respond")
+        names = [n for n in order if n in spans] \
+            + sorted(n for n in spans if n not in order)
+        for name in names:
+            row = spans[name]
+            lines.append(
+                f"| {name} | {row['count']} | {row['total_ms']:.2f} "
+                f"| {row['mean_ms']:.3f} | {row['p50_ms']:.3f} "
+                f"| {row['p99_ms']:.3f} |")
+    stream_ids = tr.get("stream_ids") or []
+    if stream_ids or tr.get("stream_events"):
+        lines.append(
+            f"- streams: {len(stream_ids)} stream(s) traced, "
+            f"{len(tr.get('stream_events') or [])} lifecycle event(s)")
+    return lines
+
+
+def _slo_lines(sv):
+    """Error-budget lines (ISSUE 20): burn-rate extremes over the run
+    and the dominant-span attribution of each breach."""
+    slo = sv.get("slo") or {}
+    if not slo.get("present"):
+        return []
+    cfg = slo.get("config") or {}
+    lines = []
+    if cfg:
+        lines.append(
+            f"- slo: p99 target {cfg.get('p99_ms')}ms at "
+            f"{cfg.get('availability')} availability "
+            f"(window {cfg.get('window')})")
+    if slo.get("burn_rate_max") is not None:
+        lines.append(
+            f"- error budget: burn rate latest "
+            f"{slo['burn_rate_latest']:.3f} / max "
+            f"{slo['burn_rate_max']:.3f}, budget remaining "
+            f"{(slo.get('budget_remaining_frac') or 0) * 100:.1f}% "
+            f"(min {(slo.get('budget_remaining_min') or 0) * 100:.1f}%)")
+    n = slo.get("breaches", 0)
+    if n:
+        lines.append(f"!! slo breaches: {n} "
+                     f"({slo.get('rejected', 0)} shed at admission)")
+        by_span = {}
+        for ev in slo.get("breach_events") or []:
+            by_span.setdefault(ev.get("dominant_span") or "rejected",
+                               []).append(ev)
+        for span in sorted(by_span, key=lambda k: -len(by_span[k])):
+            evs = by_span[span]
+            worst = max((float(e.get("e2e_ms") or 0) for e in evs),
+                        default=0.0)
+            lines.append(f"  - dominant span {span}: {len(evs)} "
+                         f"breach(es), worst e2e {worst:.1f}ms")
+    else:
+        lines.append("- slo breaches: 0")
+    return lines
+
+
+def render_serving_report(path_or_events):
+    """Standalone '## serving' deep-dive (the ``telemetry_report.py
+    --serving`` flag, matching the ``--pod`` pattern): span breakdown
+    table, SLO budget history, and the slowest sampled traces."""
+    events = (load_events(path_or_events)
+              if isinstance(path_or_events, str) else path_or_events)
+    s = summarize(events)
+    sv = s.get("serving") or {}
+    if not sv.get("present"):
+        return "# serving\n(no serving telemetry in this run)"
+    lines = ["# serving"]
+    lines.extend(_serving_section(s)[2:])  # drop the blank + "## serving"
+    slo = sv.get("slo") or {}
+    budget_series = (slo.get("series") or {}).get(
+        "serve/slo/budget_remaining_frac", [])
+    if budget_series:
+        lines.append("")
+        lines.append("budget history (step, remaining frac):")
+        step_width = max(12, len(budget_series))
+        stride = max(len(budget_series) // step_width, 1)
+        for step, value in budget_series[::stride]:
+            bar = "#" * int(round(float(value or 0) * 20))
+            lines.append(f"  {step:>6} {float(value or 0):.3f} {bar}")
+    records = (sv.get("traces") or {}).get("records") or []
+    slowest = sorted(records,
+                     key=lambda r: -float(r.get("e2e_ms") or 0))[:5]
+    if slowest:
+        lines.append("")
+        lines.append("slowest traces:")
+        for rec in slowest:
+            spans = ", ".join(
+                f"{sp['name']} {float(sp.get('dur_ms') or 0):.1f}ms"
+                for sp in rec.get("spans") or [])
+            flags = []
+            if rec.get("slo_breach"):
+                flags.append("BREACH")
+            if rec.get("evict_recompile"):
+                flags.append("evict-recompile")
+            if not rec.get("warm_hit", True):
+                flags.append("cold")
+            lines.append(
+                f"- {rec.get('trace_id')} "
+                f"e2e {float(rec.get('e2e_ms') or 0):.1f}ms on "
+                f"{rec.get('executable', '?')}"
+                + (f" [{' '.join(flags)}]" if flags else ""))
+            lines.append(f"    {spans}")
+    return "\n".join(lines)
 
 
 def render_report(path_or_events):

@@ -71,7 +71,8 @@ def check_health(summary, require_health=False, max_dg_breaches=0,
                  max_step_skew_ms=None, max_divergence=None,
                  max_straggler_share=None, max_fid=None,
                  max_quality_regressions=None,
-                 max_p99_latency_ms=None, max_queue_depth=None):
+                 max_p99_latency_ms=None, max_queue_depth=None,
+                 max_slo_burn_rate=None, min_slo_budget_frac=None):
     """Return the list of failure strings for an aggregated summary."""
     failures = []
     health = summary.get("health") or {}
@@ -297,6 +298,33 @@ def check_health(summary, require_health=False, max_dg_breaches=0,
             failures.append(
                 f"serving queue depth {depth:.0f} exceeds "
                 f"--max-queue-depth {max_queue_depth:g}")
+    # SLO error-budget gates (ISSUE 20): the burn-rate series MAX
+    # against --max-slo-burn-rate (a budget that burned and recovered
+    # still burned) and the budget-remaining minimum against
+    # --min-slo-budget-frac. Breach metas carry the dominant span, so
+    # a red gate names the stage that ate the budget. Only runs that
+    # carried serve/slo/* counters are gated (graph-gate idiom).
+    slo = serving.get("slo") or {}
+    if slo.get("present"):
+        burn_max = slo.get("burn_rate_max")
+        if max_slo_burn_rate is not None and burn_max is not None \
+                and burn_max > max_slo_burn_rate:
+            spans = sorted({e.get("dominant_span")
+                            for e in slo.get("breach_events", [])}
+                           - {None})
+            failures.append(
+                f"SLO burn rate max {burn_max:.3f} exceeds "
+                f"--max-slo-burn-rate {max_slo_burn_rate:g} "
+                f"({slo.get('breaches', 0)} breach(es), "
+                f"{slo.get('rejected', 0)} shed"
+                + (f", dominant span(s) {spans}" if spans else "")
+                + ")")
+        budget_min = slo.get("budget_remaining_min")
+        if min_slo_budget_frac is not None and budget_min is not None \
+                and budget_min < min_slo_budget_frac:
+            failures.append(
+                f"SLO budget remaining dropped to {budget_min:.3f} "
+                f"below --min-slo-budget-frac {min_slo_budget_frac:g}")
     if require_health and not health.get("has_health_counters"):
         failures.append(
             "no health/* counters in the run (diagnostics disabled or "
@@ -404,6 +432,17 @@ def main(argv=None):
                          "depth (serve/queue_depth counter) exceeds "
                          "this (default: no queue gate; runs without "
                          "serve/* counters pass)")
+    ap.add_argument("--max-slo-burn-rate", type=float, default=None,
+                    help="fail when the serving error budget's burn "
+                         "rate (serve/slo/burn_rate counter) ever "
+                         "exceeded this — 1.0 means spending budget "
+                         "exactly as fast as the SLO allows (default: "
+                         "no burn gate; runs without serve/slo/* "
+                         "counters pass)")
+    ap.add_argument("--min-slo-budget-frac", type=float, default=None,
+                    help="fail when serve/slo/budget_remaining_frac "
+                         "ever dropped below this (default: no budget "
+                         "floor)")
     ap.add_argument("--hosts", action="store_true",
                     help="aggregate every per-process telemetry file "
                          "(telemetry.jsonl + telemetry.jsonl.p*) of a "
@@ -442,7 +481,9 @@ def main(argv=None):
                             max_quality_regressions=
                             args.max_quality_regressions,
                             max_p99_latency_ms=args.max_p99_latency_ms,
-                            max_queue_depth=args.max_queue_depth)
+                            max_queue_depth=args.max_queue_depth,
+                            max_slo_burn_rate=args.max_slo_burn_rate,
+                            min_slo_budget_frac=args.min_slo_budget_frac)
     health = summary.get("health") or {}
     xla = summary.get("xla") or {}
     res = summary.get("resilience") or {}
@@ -520,6 +561,28 @@ def main(argv=None):
                     "bucket_hit_rate"),
                 "pad_waste_frac": (summary.get("serving") or {}).get(
                     "pad_waste_frac"),
+                "slo": {
+                    "present": ((summary.get("serving") or {}).get(
+                        "slo") or {}).get("present", False),
+                    "burn_rate_max": ((summary.get("serving") or {}).get(
+                        "slo") or {}).get("burn_rate_max"),
+                    "budget_remaining_min": (
+                        (summary.get("serving") or {}).get("slo")
+                        or {}).get("budget_remaining_min"),
+                    "breaches": ((summary.get("serving") or {}).get(
+                        "slo") or {}).get("breaches", 0),
+                    "rejected": ((summary.get("serving") or {}).get(
+                        "slo") or {}).get("rejected", 0),
+                },
+                "traces": {
+                    "count": ((summary.get("serving") or {}).get(
+                        "traces") or {}).get("count", 0),
+                    "breaches": ((summary.get("serving") or {}).get(
+                        "traces") or {}).get("breaches", 0),
+                    "evict_recompiles": (
+                        (summary.get("serving") or {}).get("traces")
+                        or {}).get("evict_recompiles", 0),
+                },
             },
         }, indent=1, default=str))
     elif failures:
@@ -570,7 +633,10 @@ def _main_hosts(args):
                                 args.max_quality_regressions,
                                 max_p99_latency_ms=
                                 args.max_p99_latency_ms,
-                                max_queue_depth=args.max_queue_depth)
+                                max_queue_depth=args.max_queue_depth,
+                                max_slo_burn_rate=args.max_slo_burn_rate,
+                                min_slo_budget_frac=
+                                args.min_slo_budget_frac)
         verdicts[label] = {"path": fpath, "healthy": not failures,
                            "failures": failures}
         any_fail = any_fail or bool(failures)

@@ -15,12 +15,20 @@ Usage:
     python scripts/telemetry_report.py logs/<run>            # dir works too
     python scripts/telemetry_report.py <path> --json         # machine-readable
     python scripts/telemetry_report.py logs/<run> --pod      # pod timeline
+    python scripts/telemetry_report.py logs/<run> --serving  # trace/SLO view
 
 ``--pod`` (ISSUE 17) merges every per-process ``telemetry.jsonl.p<i>``
 of the run into one clock-aligned pod timeline — per-host lanes,
 per-step skew histogram, span-level straggler table — instead of the
 single-file phase report; with ``--json`` it dumps the merged
 structure.
+
+``--serving`` (ISSUE 20) renders the request-scoped serving view from
+the run's ``trace/`` records and ``serve/slo/*`` counters: the span
+cost table (where request time goes, stage by stage), the SLO error-
+budget history, breach attribution grouped by dominant span, and the
+slowest sampled traces; with ``--json`` it dumps the serving summary
+block (traces + slo) that ``check_run_health`` gates on.
 
 The MFU shown is reproducible from the JSONL alone: the ``step_flops``
 meta event records the XLA cost analysis (and the peak-FLOPs source),
@@ -57,8 +65,30 @@ def main():
                     help="merge all per-process telemetry files into "
                          "one clock-aligned pod timeline (per-host "
                          "lanes, skew histogram, straggler table)")
+    ap.add_argument("--serving", action="store_true",
+                    help="render the request-scoped serving view "
+                         "(span cost table, SLO budget history, "
+                         "breach attribution, slowest traces)")
     args = ap.parse_args()
     path = args.path
+    if args.serving:
+        from imaginaire_tpu.telemetry.report import render_serving_report
+
+        if os.path.isdir(path):
+            path = os.path.join(path, "telemetry.jsonl")
+        if not os.path.exists(path):
+            raise SystemExit(f"no telemetry.jsonl at {path}")
+        summary = summarize(load_events(path))
+        serving = summary.get("serving") or {}
+        if not serving.get("present"):
+            raise SystemExit(f"no serve/* or trace/ events in {path} — "
+                             f"did the run use the serving engine with "
+                             f"telemetry enabled?")
+        if args.json:
+            print(json.dumps(serving, indent=1, default=str))
+        else:
+            print(render_serving_report(path))
+        return
     if args.pod:
         from imaginaire_tpu.telemetry.podview import (
             merge_pod_timeline,

@@ -1,0 +1,506 @@
+"""Request-scoped serving observability (ISSUE 20): trace span
+completeness/contiguity, deterministic sampling, eviction attribution,
+SLO error-budget math + breach emission, chaos latency injection,
+loadgen determinism, the burn-rate health gates, and the --serving
+report section."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import __graft_entry__ as ge  # noqa: E402
+from imaginaire_tpu import telemetry  # noqa: E402
+from imaginaire_tpu.registry import resolve  # noqa: E402
+from imaginaire_tpu.resilience import chaos as chaos_mod  # noqa: E402
+from imaginaire_tpu.serving import (  # noqa: E402
+    REQUEST_SPANS,
+    ErrorBudget,
+    RequestTrace,
+    ServeRequest,
+    ServingEngine,
+    ServingError,
+    Tracer,
+    poisson_arrivals,
+    run_open_loop,
+    slo_settings,
+)
+from imaginaire_tpu.serving.engine import _percentile  # noqa: E402
+from imaginaire_tpu.serving.tracing import sampled  # noqa: E402
+from imaginaire_tpu.telemetry.report import (  # noqa: E402
+    render_serving_report,
+    summarize,
+)
+from scripts.check_run_health import check_health  # noqa: E402
+
+H = W = 64
+LABELS = 5
+
+
+def _mem_telemetry():
+    return telemetry.configure(enabled=True, sinks=[],
+                               flush_every_n_steps=0, mfu=False)
+
+
+def _mk_request(seed, h=H, w=W):
+    rng = np.random.RandomState(seed)
+    return ServeRequest(
+        data={"label": rng.rand(1, h, w, LABELS).astype(np.float32),
+              "images": np.zeros((1, h, w, 3), np.float32)},
+        seed=seed)
+
+
+def _events(tm, kind=None, name=None):
+    with tm._lock:
+        evs = list(tm._events)
+    return [e for e in evs
+            if (kind is None or e.get("kind") == kind)
+            and (name is None or e.get("name") == name)]
+
+
+# ----------------------------------------------------------- sampling
+
+
+def test_sampling_deterministic_pure_function():
+    assert all(sampled(i, 1.0) for i in range(50))
+    assert not any(sampled(i, 0.0) for i in range(50))
+    first = [sampled(i, 0.25) for i in range(2000)]
+    assert first == [sampled(i, 0.25) for i in range(2000)]
+    frac = sum(first) / len(first)
+    assert 0.15 < frac < 0.35, frac
+
+
+# ------------------------------------------------------- trace spans
+
+
+def test_trace_spans_contiguous_and_sum_to_e2e():
+    tr = RequestTrace("spade/r1", 1, t0=100.0)
+    tr.begin("admit", t=100.0)
+    t = 100.0
+    for name in REQUEST_SPANS[1:]:
+        t += 0.010
+        tr.mark(name, t=t)
+    tr.finish(t=t + 0.010)
+    assert tr.span_names() == list(REQUEST_SPANS)
+    span_sum = sum(s["dur_ms"] for s in tr.spans)
+    assert span_sum == pytest.approx(tr.e2e_ms, rel=1e-6)
+    assert tr.e2e_ms == pytest.approx(70.0, rel=1e-6)
+
+
+def test_trace_dominant_span_and_annotations():
+    tr = RequestTrace("spade/r2", 2, t0=0.0)
+    tr.begin("admit", t=0.0)
+    tr.mark("queue_wait", t=0.001)
+    tr.mark("execute", t=0.002)
+    tr.finish(t=0.042)  # execute ran 40ms
+    name, dur = tr.dominant_span()
+    assert name == "execute" and dur == pytest.approx(40.0, rel=1e-3)
+    tr.annotate(executable="serve/spade/64x64/bs4", padded=2)
+    rec = tr.record()
+    assert rec["executable"] == "serve/spade/64x64/bs4"
+    assert rec["padded"] == 2 and rec["trace_id"] == "spade/r2"
+
+
+def test_breach_trace_emitted_despite_sampling_drop():
+    tm = _mem_telemetry()
+    tracer = Tracer("spade", sample_rate=0.0)
+    tr = tracer.admit(7, t0=0.0)
+    tr.mark("respond", t=0.001).finish(t=0.002)
+    assert tracer.emit(tr) is False  # dropped: unsampled, no breach
+    tr2 = tracer.admit(8, t0=0.0)
+    tr2.mark("respond", t=0.001).finish(t=0.002)
+    tr2.slo_breach = True
+    assert tracer.emit(tr2) is True  # breaches ALWAYS emit
+    recs = _events(tm, kind="trace", name="trace/request")
+    assert len(recs) == 1 and recs[0]["request_id"] == 8
+    assert tracer.dropped == 1 and tracer.emitted == 1
+
+
+# ------------------------------------------------------- error budget
+
+
+def test_error_budget_math():
+    b = ErrorBudget(p99_ms=100.0, availability=0.9, window=10)
+    for _ in range(9):
+        assert b.observe(10.0) is False
+    assert b.burn_rate() == 0.0 and b.budget_remaining_frac() == 1.0
+    _mem_telemetry()
+    assert b.observe(500.0) is True  # 1 bad / 10 => bad_frac 0.1
+    assert b.burn_rate() == pytest.approx(1.0)  # == allowed 0.1
+    assert b.budget_remaining_frac() == pytest.approx(0.0)
+    assert b.breaches == 1
+    b.reset()
+    assert b.burn_rate() == 0.0 and b.breaches == 0
+
+
+def test_error_budget_rejection_counts_as_availability_failure():
+    _mem_telemetry()
+    b = ErrorBudget(p99_ms=100.0, availability=0.999, window=16)
+    assert b.observe_rejected() is True
+    assert b.rejected == 1 and b.breaches == 1
+    assert b.burn_rate() > 1.0  # 1/1 bad vs 0.001 allowed
+
+
+def test_error_budget_disabled_never_breaches():
+    b = ErrorBudget(p99_ms=None)
+    assert not b.enabled
+    assert b.observe(1e9) is False
+    assert b.observe_rejected() is False
+    assert b.burn_rate() == 0.0 and b.breaches == 0
+
+
+def test_slo_settings_parse():
+    s = slo_settings({"serving": {"slo": {"p99_ms": 250,
+                                          "availability": 0.99,
+                                          "window": 64}}})
+    assert s == {"p99_ms": 250.0, "availability": 0.99, "window": 64}
+    assert slo_settings({})["p99_ms"] is None  # disabled by default
+    assert slo_settings(None)["window"] == 256
+
+
+# -------------------------------------------------- percentile (sat 2)
+
+
+def test_percentile_tiny_samples():
+    assert _percentile([], 0.99) is None
+    assert _percentile([42.0], 0.5) == 42.0
+    assert _percentile([42.0], 0.99) == 42.0
+    # two samples: linear interpolation, not nearest-rank collapse
+    assert _percentile([10.0, 20.0], 0.5) == pytest.approx(15.0)
+    assert _percentile([10.0, 20.0], 0.99) == pytest.approx(19.9)
+    assert _percentile([10.0, 20.0, 30.0], 0.0) == 10.0
+    assert _percentile([10.0, 20.0, 30.0], 1.0) == 30.0
+
+
+# -------------------------------------------------- engine integration
+
+
+@pytest.fixture(scope="module")
+def traced_engine():
+    """Tiny SPADE engine with tracing at 1.0 and the budget armed at a
+    breach-proof objective (the span/attribution tests need traces, not
+    breaches)."""
+    _mem_telemetry()
+    cfg = ge._tiny_cfg()
+    cfg.serving.buckets = [[H, W], [96, 96]]
+    cfg.serving.batch_sizes = [1, 4]
+    cfg.serving.trace_sample_rate = 1.0
+    cfg.serving.slo.p99_ms = 600000.0
+    batch = ge._tiny_batch(1, h=H, w=W, labels=LABELS)
+    trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+    engine = ServingEngine(cfg, trainer=trainer)
+    engine.register_example(trainer.start_of_iteration(batch, 0))
+    engine.initialize(example_batch=batch)
+    return engine
+
+
+def test_padded_bucketed_request_trace_complete(traced_engine):
+    """The acceptance shape: a padded, bucketed request's trace carries
+    every pipeline span exactly once, monotone, summing to within
+    tolerance of the wall e2e latency."""
+    tm = _mem_telemetry()
+    reqs = ([_mk_request(900 + i) for i in range(5)]  # 4+1 @64
+            + [_mk_request(950 + i, h=96, w=96) for i in range(2)])
+    traced_engine.serve(reqs)
+    recs = {r["request_id"]: r
+            for r in _events(tm, kind="trace", name="trace/request")}
+    for req in reqs:
+        rec = recs[req.id]
+        names = [s["name"] for s in rec["spans"]]
+        assert names == list(REQUEST_SPANS), names  # each exactly once
+        durs = [s["dur_ms"] for s in rec["spans"]]
+        assert all(d >= 0.0 for d in durs)  # contiguous => monotone
+        assert sum(durs) == pytest.approx(rec["e2e_ms"], rel=0.10,
+                                          abs=0.5)
+        assert rec["executable"].startswith("serve/spade/")
+        assert rec["warm_hit"] in (True, False)
+    # the 2-request 96x96 group padded up to bs4
+    padded = [recs[r.id] for r in reqs[5:]]
+    assert all(p["padded"] == 2 and p["batch_size"] == 4
+               for p in padded), padded
+    # SLO counters flowed alongside (armed budget, no breaches)
+    assert _events(tm, kind="counter", name="serve/slo/burn_rate")
+    assert not _events(tm, kind="meta", name="serve/slo/breach")
+
+
+def test_queue_depth_emitted_once_per_batch(traced_engine):
+    """Satellite 1: serve/queue_depth comes from the post-batch flush
+    block only — submit() must not interleave a second cadence."""
+    tm = _mem_telemetry()
+    for i in range(3):
+        traced_engine.submit(_mk_request(1000 + i))
+    assert not _events(tm, kind="counter", name="serve/queue_depth")
+    traced_engine.flush()
+    depth_events = _events(tm, kind="counter", name="serve/queue_depth")
+    flush_events = _events(tm, kind="counter", name="serve/requests")
+    assert len(depth_events) >= 1
+    # exactly one emission per post-batch flush block, none at enqueue
+    assert len(depth_events) == len(flush_events)
+
+
+def test_evict_recompile_attribution():
+    """A slow request caused by evict-then-recompile must say so: pool
+    of ONE, alternate buckets, the re-admitted bucket's trace carries
+    evict_recompile=True (a plain cold compile does not)."""
+    tm = _mem_telemetry()
+    cfg = ge._tiny_cfg()
+    cfg.serving.buckets = [[H, W], [96, 96]]
+    cfg.serving.batch_sizes = [1]
+    cfg.serving.max_executables = 1
+    cfg.serving.trace_sample_rate = 1.0
+    batch = ge._tiny_batch(1, h=H, w=W, labels=LABELS)
+    trainer = resolve(cfg.trainer.type, "Trainer")(cfg)
+    engine = ServingEngine(cfg, trainer=trainer)
+    engine.register_example(trainer.start_of_iteration(batch, 0))
+    engine.initialize(example_batch=batch)
+    r_cold = _mk_request(1100)
+    r_evictor = _mk_request(1101, h=96, w=96)
+    r_rebuilt = _mk_request(1102)
+    engine.serve([r_cold])     # cold build @64
+    engine.serve([r_evictor])  # evicts the 64 executable
+    engine.serve([r_rebuilt])  # rebuild of a previously-evicted key
+    recs = {r["request_id"]: r
+            for r in _events(tm, kind="trace", name="trace/request")}
+    assert recs[r_cold.id]["evict_recompile"] is False  # cold != evicted
+    assert recs[r_rebuilt.id]["evict_recompile"] is True
+    assert recs[r_rebuilt.id]["warm_hit"] is False
+
+
+def test_queue_shed_request_trace_and_budget(traced_engine):
+    tm = _mem_telemetry()
+    traced_engine.settings["max_queue"] = 2
+    traced_engine.queue.max_depth = 2
+    rejected_before = traced_engine.budget.rejected
+    try:
+        with pytest.raises(ServingError):
+            for i in range(4):
+                traced_engine.submit(_mk_request(1200 + i))
+    finally:
+        traced_engine.flush()
+        traced_engine.settings["max_queue"] = 64
+        traced_engine.queue.max_depth = 64
+    assert traced_engine.budget.rejected == rejected_before + 1
+    breach = _events(tm, kind="meta", name="serve/slo/breach")
+    assert breach and breach[-1]["rejected"] is True
+    shed = [r for r in _events(tm, kind="trace", name="trace/request")
+            if r.get("rejected")]
+    assert shed and shed[-1]["slo_breach"] is True
+    assert shed[-1]["spans"][-1]["name"] == "respond"
+
+
+# ------------------------------------------------------- stream traces
+
+
+class _StubV2VTrainer:
+    num_frames_G = 3
+    state = {"vars_G": {"params": {}}}
+    net_G = None
+
+    def inference_params(self):
+        return {"params": {}}
+
+    def _start_of_iteration(self, data, it):
+        return data
+
+    def _get_data_t(self, data, t, prev_labels, prev_images):
+        return {"label": data["label"], "prev_labels": prev_labels,
+                "prev_images": prev_images}
+
+    def _apply_G(self, vars_G, data_t, rng, training=False):
+        return {"fake_images": 2.0 * data_t["label"][..., :3]}, {}
+
+
+def _frame(value):
+    return {"label": np.full((1, H, W, 3), value, np.float32)}
+
+
+def test_stream_traces_keep_per_stream_isolation():
+    tm = _mem_telemetry()
+    cfg = ge._tiny_cfg()
+    cfg.serving.buckets = [[H, W]]
+    cfg.serving.trace_sample_rate = 1.0
+    engine = ServingEngine(cfg, trainer=_StubV2VTrainer(),
+                           family="fs_vid2vid")
+    a = engine.stream("camA")
+    b = engine.stream("camB")
+    a.step(_frame(1.0))
+    b.step(_frame(1.0))
+    a.step(_frame(1.0))
+    a.reset()
+    engine.close_stream("camA")
+    life = _events(tm, kind="trace", name="trace/stream")
+    by_event = {}
+    for ev in life:
+        by_event.setdefault(ev["event"], []).append(ev)
+    assert {e["stream_id"] for e in by_event["open"]} == {"camA", "camB"}
+    assert by_event["reset"][0]["stream_id"] == "camA"
+    assert by_event["close"][0]["stream_id"] == "camA"
+    frames = [r for r in _events(tm, kind="trace", name="trace/request")
+              if r.get("stream_id")]
+    per_stream = {}
+    for r in frames:
+        per_stream.setdefault(r["stream_id"], []).append(r["frame"])
+    # frame numbering is per-stream (camA interleaved twice, camB once)
+    assert per_stream == {"camA": [0, 1], "camB": [0]}
+    assert all(r["trace_id"].startswith(f"fs_vid2vid/{r['stream_id']}/")
+               for r in frames)
+
+
+# ----------------------------------------------------------- chaos hook
+
+
+def test_chaos_delay_serve_one_shot():
+    tm = _mem_telemetry()
+    chaos = chaos_mod.ChaosMonkey(chaos_mod.chaos_settings(
+        {"chaos": {"enabled": True, "delay_serve_at_request": 2,
+                   "delay_serve_ms": 1.0}}))
+    chaos.maybe_delay_serve(1)  # before the armed ordinal: no-op
+    chaos.maybe_delay_serve(2)
+    chaos.maybe_delay_serve(2)  # one-shot: a retry never re-fires
+    metas = _events(tm, kind="meta", name="chaos/delay_serve")
+    assert len(metas) == 1 and metas[0]["step"] == 2
+    assert chaos_mod.chaos_settings({})["delay_serve_at_request"] is None
+    chaos_mod._NullChaos().maybe_delay_serve(2)  # inert default
+
+
+# -------------------------------------------------------------- loadgen
+
+
+def test_poisson_arrivals_deterministic_and_rate_shaped():
+    a1 = poisson_arrivals(100.0, 5.0, np.random.default_rng(3))
+    a2 = poisson_arrivals(100.0, 5.0, np.random.default_rng(3))
+    assert a1 == a2
+    assert all(0 < t < 5.0 for t in a1)
+    assert a1 == sorted(a1)
+    assert 350 < len(a1) < 650  # ~500 expected
+
+
+def test_open_loop_point_shape(traced_engine):
+    _mem_telemetry()
+    traced_engine.reset_stats()
+    rng = np.random.RandomState(5)
+    lanes = {(H, W): {"label": rng.rand(1, H, W, LABELS)
+                      .astype(np.float32),
+                      "images": np.zeros((1, H, W, 3), np.float32)}}
+    point = run_open_loop(traced_engine, rate_rps=40.0, duration_s=0.4,
+                          lanes=lanes, seed=11)
+    assert point["mode"] == "open" and point["offered_rps"] == 40.0
+    assert point["served"] == point["requests"] > 0
+    assert point["rejected"] == 0
+    assert point["p50_ms"] > 0 and point["p99_ms"] >= point["p50_ms"]
+    assert point["queue_depth_max"] >= 0
+    assert point["slo_burn_rate"] == 0.0  # breach-proof objective
+
+
+def test_reset_stats_clears_window_but_not_step_axis(traced_engine):
+    _mem_telemetry()
+    traced_engine.serve([_mk_request(1300)])
+    batches_before = traced_engine.stats()["batches"]
+    assert traced_engine.stats()["requests"] > 0
+    traced_engine.reset_stats()
+    st = traced_engine.stats()
+    assert st["requests"] == 0 and st["p99_ms"] is None
+    assert st["slo_burn_rate"] == 0.0 and st["slo_breaches"] == 0
+    # the counter step axis stays monotone across measurement windows
+    assert st["batches"] == batches_before
+
+
+# ------------------------------------------------------------ SLO gates
+
+
+def _summary(burn_max=0.0, budget_min=1.0, present=True):
+    return {"serving": {
+        "present": True, "p99_ms": 10.0, "queue_depth": 0,
+        "slo": {"present": present, "burn_rate_max": burn_max,
+                "budget_remaining_min": budget_min, "breaches": 2,
+                "rejected": 1,
+                "breach_events": [{"dominant_span": "execute"}]},
+    }}
+
+
+def test_burn_rate_gate_pass():
+    assert check_health(_summary(burn_max=0.4),
+                        max_slo_burn_rate=0.5) == []
+
+
+def test_burn_rate_gate_fail_names_dominant_span():
+    failures = check_health(_summary(burn_max=250.0),
+                            max_slo_burn_rate=0.5)
+    assert any("burn" in f and "execute" in f for f in failures), failures
+
+
+def test_budget_floor_gate_fail():
+    failures = check_health(_summary(budget_min=0.1),
+                            min_slo_budget_frac=0.5)
+    assert any("budget" in f for f in failures), failures
+
+
+def test_slo_gates_graph_gated_without_slo_counters():
+    assert check_health(_summary(burn_max=99.0, present=False),
+                        max_slo_burn_rate=0.001,
+                        min_slo_budget_frac=0.999) == []
+    assert check_health({}, max_slo_burn_rate=0.001) == []
+
+
+# --------------------------------------------------------------- report
+
+
+def _synthetic_events():
+    evs = [
+        {"kind": "counter", "name": "serve/p99_ms", "value": 30.0,
+         "step": 1, "t": 1.0},
+        {"kind": "counter", "name": "serve/requests", "value": 2,
+         "step": 1, "t": 1.0},
+        {"kind": "counter", "name": "serve/slo/burn_rate", "value": 2.5,
+         "step": 1, "t": 1.0},
+        {"kind": "counter", "name": "serve/slo/budget_remaining_frac",
+         "value": 0.0, "step": 1, "t": 1.0},
+        {"kind": "meta", "name": "serve/slo/config", "p99_ms": 25.0,
+         "availability": 0.999, "window": 256, "t": 1.0},
+        {"kind": "meta", "name": "serve/slo/breach", "target_ms": 25.0,
+         "rejected": False, "e2e_ms": 30.0, "trace_id": "spade/r1",
+         "dominant_span": "execute", "dominant_span_ms": 28.0, "t": 1.0},
+        {"kind": "trace", "name": "trace/request", "trace_id": "spade/r1",
+         "request_id": 1, "trace_kind": "request", "sampled": True,
+         "slo_breach": True, "e2e_ms": 30.0, "t": 1.0,
+         "spans": [{"name": "admit", "dur_ms": 0.5},
+                   {"name": "queue_wait", "dur_ms": 1.0},
+                   {"name": "execute", "dur_ms": 28.0},
+                   {"name": "respond", "dur_ms": 0.5}],
+         "executable": "serve/spade/64x64/bs1", "warm_hit": True,
+         "evict_recompile": False},
+        {"kind": "trace", "name": "trace/stream", "event": "open",
+         "stream_id": "camA", "family": "fs_vid2vid", "t": 1.0},
+    ]
+    return evs
+
+
+def test_summarize_trace_and_slo_blocks():
+    s = summarize(_synthetic_events())
+    sv = s["serving"]
+    tr = sv["traces"]
+    assert tr["present"] and tr["count"] == 1 and tr["breaches"] == 1
+    assert tr["spans"]["execute"]["total_ms"] == pytest.approx(28.0)
+    assert tr["stream_ids"] == ["camA"]
+    slo = sv["slo"]
+    assert slo["present"] and slo["burn_rate_max"] == 2.5
+    assert slo["budget_remaining_min"] == 0.0
+    assert slo["config"]["p99_ms"] == 25.0
+    assert slo["breach_events"][0]["dominant_span"] == "execute"
+
+
+def test_render_serving_report():
+    out = render_serving_report(_synthetic_events())
+    assert "execute" in out and "spade/r1" in out
+    assert "burn" in out.lower()
+    assert "BREACH" in out
+
+
+def test_render_serving_report_without_serving_events():
+    out = render_serving_report([{"kind": "counter", "name": "x",
+                                  "value": 1, "step": 0, "t": 0.0}])
+    assert "no serving telemetry" in out.lower()
